@@ -1,0 +1,22 @@
+"""bitcoin_miner_tpu — a TPU-native distributed hash-search framework.
+
+A ground-up rebuild of the capabilities of the CMU 15-440 distributed bitcoin
+miner (reference: jack-nie/bitcoin-miner): the LSP reliable-UDP transport plus
+the three-role mining application (client / scheduler server / miner), with the
+hash search re-designed TPU-first — a vectorised SHA-256 kernel (jnp + Pallas
+tiers) swept over nonce ranges, min-hash reduced in-kernel, across chips with
+XLA collectives, and across miner processes by the scheduler's range split.
+
+Layer map (mirrors reference SURVEY §1, re-architected for asyncio + JAX):
+
+  L1  lspnet/    instrumented asyncio-UDP with fault-injection knobs
+  L2  lsp/       the LSP reliable, ordered transport (window/ack/epoch/drain)
+  L3  bitcoin/   application wire protocol + hash semantics (CPU oracle)
+      ops/       SHA-256 TPU kernels (jnp vmap tier, Pallas tier)
+      models/    the flagship "miner model": chunked min-hash search step
+      parallel/  device-mesh sharding: shard_map + psum-style min collectives
+  L4  apps/      server / miner / client binaries + echo runners
+      utils/     logging, counters, config
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU platform *before* jax is imported
+anywhere, so multi-chip sharding paths (Mesh / shard_map / collectives) are
+exercised without TPU hardware.  bench.py and the driver's graft entry run
+outside pytest and therefore see the real TPU.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,110 @@
+"""B1 golden tests: byte-compatibility with Go encoding/json on the frozen
+wire structs (reference lsp/message.go, bitcoin/message.go, lsp/params.go).
+
+Golden strings below were derived from Go's documented marshalling rules:
+field names are the exported struct names, []byte marshals to std-base64
+(null when nil), ints as plain numbers, no whitespace.
+"""
+
+import json
+
+from bitcoin_miner_tpu import bitcoin
+from bitcoin_miner_tpu import lsp
+
+
+class TestLspMessage:
+    def test_connect_golden(self):
+        # Go: json.Marshal(NewConnect()) with nil payload -> null
+        assert (
+            lsp.Message.connect().marshal()
+            == b'{"Type":0,"ConnID":0,"SeqNum":0,"Size":0,"Payload":null}'
+        )
+
+    def test_data_golden(self):
+        m = lsp.Message.data(5, 3, 4, b"abcd")
+        assert (
+            m.marshal()
+            == b'{"Type":1,"ConnID":5,"SeqNum":3,"Size":4,"Payload":"YWJjZA=="}'
+        )
+
+    def test_ack_golden(self):
+        assert (
+            lsp.Message.ack(7, 0).marshal()
+            == b'{"Type":2,"ConnID":7,"SeqNum":0,"Size":0,"Payload":null}'
+        )
+
+    def test_roundtrip(self):
+        m = lsp.Message.data(42, 17, 11, b"hello world")
+        out = lsp.Message.unmarshal(m.marshal())
+        assert out == m
+
+    def test_unmarshal_go_produced_bytes(self):
+        # A Data packet as the Go side would emit it.
+        go_bytes = b'{"Type":1,"ConnID":1,"SeqNum":1,"Size":3,"Payload":"Zm9v"}'
+        m = lsp.Message.unmarshal(go_bytes)
+        assert m.type == lsp.MsgType.DATA
+        assert (m.conn_id, m.seq_num, m.size, m.payload) == (1, 1, 3, b"foo")
+
+    def test_unmarshal_junk_returns_none(self):
+        assert lsp.Message.unmarshal(b"\xff\xfe not json") is None
+        assert lsp.Message.unmarshal(b"[1,2,3]") is None
+
+    def test_string_parity(self):
+        # lsp/message.go:55-68 format "[Name connID seqNum payload?]"
+        assert str(lsp.Message.connect()) == "[Connect 0 0]"
+        assert str(lsp.Message.ack(3, 9)) == "[Ack 3 9]"
+        assert str(lsp.Message.data(1, 2, 2, b"hi")) == "[Data 1 2 hi]"
+
+
+class TestBitcoinMessage:
+    def test_request_golden(self):
+        m = bitcoin.Message.request("cmu440", 0, 9999)
+        assert m.marshal() == (
+            b'{"Type":1,"Data":"cmu440","Lower":0,"Upper":9999,"Hash":0,"Nonce":0}'
+        )
+
+    def test_result_golden_u64(self):
+        # Values above 2^53 must round-trip exactly (Go uint64 semantics).
+        h = (1 << 64) - 3
+        m = bitcoin.Message.result(h, 123456789012345678)
+        obj = json.loads(m.marshal())
+        assert obj["Hash"] == h
+        assert obj["Nonce"] == 123456789012345678
+        assert bitcoin.Message.unmarshal(m.marshal()) == m
+
+    def test_join_golden(self):
+        assert bitcoin.Message.join().marshal() == (
+            b'{"Type":0,"Data":"","Lower":0,"Upper":0,"Hash":0,"Nonce":0}'
+        )
+
+    def test_string_parity(self):
+        assert str(bitcoin.Message.join()) == "[Join]"
+        assert str(bitcoin.Message.request("d", 1, 2)) == "[Request d 1 2]"
+        assert str(bitcoin.Message.result(10, 20)) == "[Result 10 20]"
+
+
+class TestParams:
+    def test_defaults(self):
+        p = lsp.Params()
+        assert (p.epoch_limit, p.epoch_millis, p.window_size) == (5, 2000, 1)
+        assert str(p) == "[EpochLimit: 5, EpochMillis: 2000, WindowSize: 1]"
+
+    def test_max_message_size(self):
+        assert lsp.MAX_MESSAGE_SIZE == 1000
+
+
+class TestHashOracle:
+    def test_hash_known_values(self):
+        # Independently computed: SHA256(b"cmu440 0")[:8] big-endian.
+        import hashlib
+
+        for msg, nonce in [("cmu440", 0), ("cmu440", 12345), ("hello", 999999)]:
+            d = hashlib.sha256(f"{msg} {nonce}".encode()).digest()
+            assert bitcoin.hash_nonce(msg, nonce) == int.from_bytes(d[:8], "big")
+
+    def test_min_hash_range_matches_bruteforce(self):
+        h, n = bitcoin.min_hash_range("cmu440", 0, 500)
+        best = min(
+            ((bitcoin.hash_nonce("cmu440", i), i) for i in range(501)),
+        )
+        assert (h, n) == best
