@@ -9,6 +9,7 @@ Public surface mirrors the reference's frozen APIs
 - :class:`Params`, :class:`Message`, errors
 """
 
+from .aio import AsyncClient, AsyncServer
 from .errors import (
     CannotEstablishConnectionError,
     ConnClosedError,
@@ -18,8 +19,13 @@ from .errors import (
 )
 from .message import Message, MsgType
 from .params import Params
+from .sync import Client, Server
 
 __all__ = [
+    "AsyncClient",
+    "AsyncServer",
+    "Client",
+    "Server",
     "Message",
     "MsgType",
     "Params",

@@ -1,0 +1,38 @@
+"""lspnet — instrumented UDP with fault-injection knobs (L1).
+
+The LSP transport (L2) must use these endpoints exclusively, so tests can
+dial packet loss / corruption on a real loopback network (reference
+lspnet/net.go:3-8).
+"""
+
+from .faults import (
+    FAULTS,
+    enable_debug_logs,
+    reset_faults,
+    set_client_read_drop_percent,
+    set_client_write_drop_percent,
+    set_msg_lengthening_percent,
+    set_msg_shortening_percent,
+    set_read_drop_percent,
+    set_server_read_drop_percent,
+    set_server_write_drop_percent,
+    set_write_drop_percent,
+)
+from .udp import UDPEndpoint, create_client_endpoint, create_server_endpoint
+
+__all__ = [
+    "FAULTS",
+    "UDPEndpoint",
+    "create_client_endpoint",
+    "create_server_endpoint",
+    "enable_debug_logs",
+    "reset_faults",
+    "set_read_drop_percent",
+    "set_write_drop_percent",
+    "set_client_read_drop_percent",
+    "set_server_read_drop_percent",
+    "set_client_write_drop_percent",
+    "set_server_write_drop_percent",
+    "set_msg_shortening_percent",
+    "set_msg_lengthening_percent",
+]

@@ -1,0 +1,151 @@
+"""Instrumented asyncio-UDP endpoints (L1).
+
+Parity: reference ``lspnet/conn.go`` + ``lspnet/net.go`` — a thin wrapper
+over real datagram sockets whose reads/writes can probabilistically drop
+packets (writes *report success* while dropping, conn.go:102-108) and
+mutate Data-message payloads to be shorter/longer than their ``Size`` field
+(conn.go:119-146).  The LSP layer is required to go through this seam so
+tests can fake lossy networks over loopback (lspnet/net.go:5-7); the
+conn-origin registry (net.go:16-22) is realised as the ``is_server`` flag so
+client/server drop rates can differ.
+
+Like the reference (conn.go:17-24, a deliberate abstraction break), the
+mutator peeks into the JSON wire format rather than importing the lsp
+package: it edits the base64 ``Payload`` field in place.  Divergence from
+the reference's quirky int-vs-bytes mutation branches (conn.go:123-141):
+we always halve / extend the payload bytes — the observable property the
+lsp5 suite depends on (len(payload) != Size in the right direction) is
+identical.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+from typing import Optional, Tuple
+
+from .faults import FAULTS
+
+Addr = Tuple[str, int]
+
+
+def _mutate_datagram(data: bytes) -> bytes:
+    """Apply shorten/lengthen mutation to a Data-message datagram."""
+    if FAULTS.msg_shorten == 0 and FAULTS.msg_lengthen == 0:
+        return data
+    try:
+        obj = json.loads(data.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return data
+    if not isinstance(obj, dict) or obj.get("Type") != 1:
+        return data
+    raw = obj.get("Payload")
+    payload = b"" if raw is None else base64.standard_b64decode(raw)
+    shorten = FAULTS.sometimes(FAULTS.msg_shorten)
+    lengthen = FAULTS.sometimes(FAULTS.msg_lengthen)
+    if shorten:
+        payload = payload[: len(payload) // 2]
+    elif lengthen:
+        payload = payload + b"\x02\x03\x04"
+    else:
+        return data
+    obj["Payload"] = base64.standard_b64encode(payload).decode("ascii")
+    return json.dumps(obj, separators=(",", ":")).encode("utf-8")
+
+
+class _QueueProtocol(asyncio.DatagramProtocol):
+    def __init__(self) -> None:
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.transport: Optional[asyncio.DatagramTransport] = None
+
+    def connection_made(self, transport) -> None:  # pragma: no cover - trivial
+        self.transport = transport
+
+    def datagram_received(self, data: bytes, addr: Addr) -> None:
+        self.queue.put_nowait((data, addr))
+
+    def error_received(self, exc) -> None:  # ICMP errors etc: ignore like UDP
+        pass
+
+
+class UDPEndpoint:
+    """A fault-injected datagram endpoint.
+
+    ``recv`` applies the read-drop knob (dropped packets are consumed and
+    discarded, like conn.go:48-59's retry loop); ``send`` applies the
+    write-drop knob (silently succeeding, conn.go:102-108) and the payload
+    mutation knobs.
+    """
+
+    def __init__(
+        self, transport: asyncio.DatagramTransport, protocol: _QueueProtocol,
+        is_server: bool, remote: Optional[Addr] = None,
+    ) -> None:
+        self._transport = transport
+        self._protocol = protocol
+        self.is_server = is_server
+        self._remote = remote
+        self._closed = False
+
+    @property
+    def local_addr(self) -> Addr:
+        return self._transport.get_extra_info("sockname")[:2]
+
+    async def recv(self) -> Tuple[bytes, Addr]:
+        """Await the next non-dropped datagram."""
+        while True:
+            data, addr = await self._protocol.queue.get()
+            if data is None:  # close sentinel
+                raise ConnectionError("endpoint closed")
+            if FAULTS.sometimes(FAULTS.read_drop_percent(self.is_server)):
+                if FAULTS.debug:
+                    print(f"lspnet: DROPPING read packet of length {len(data)}")
+                continue
+            return data, addr
+
+    def send(self, data: bytes, addr: Optional[Addr] = None) -> None:
+        """Fire-and-forget datagram send (UDP semantics: no delivery
+        guarantee either way, so a dropped write still 'succeeds')."""
+        if self._closed:
+            return
+        if FAULTS.sometimes(FAULTS.write_drop_percent(self.is_server)):
+            if FAULTS.debug:
+                print(f"lspnet: DROPPING written packet of length {len(data)}")
+            return
+        data = _mutate_datagram(data)
+        if addr is None:
+            addr = self._remote
+        if addr is None:
+            raise ValueError("no destination address")
+        self._transport.sendto(data, addr)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._protocol.queue.put_nowait((None, ("", 0)))
+            self._transport.close()
+
+
+async def create_server_endpoint(host: str = "127.0.0.1", port: int = 0) -> UDPEndpoint:
+    """Bind a server-side endpoint (port 0 -> ephemeral)."""
+    loop = asyncio.get_running_loop()
+    transport, protocol = await loop.create_datagram_endpoint(
+        _QueueProtocol, local_addr=(host, port)
+    )
+    return UDPEndpoint(transport, protocol, is_server=True)
+
+
+async def create_client_endpoint(host: str, port: int) -> UDPEndpoint:
+    """Create a client-side endpoint targeting ``host:port``.
+
+    Not connect()ed at the OS level: we record the remote address instead,
+    so the endpoint keeps receiving even across server socket rebinds, and
+    reply-address checks stay in the LSP layer (like the Go client's use of
+    DialUDP, net.go:60-79, but without kernel-level filtering).
+    """
+    loop = asyncio.get_running_loop()
+    transport, protocol = await loop.create_datagram_endpoint(
+        _QueueProtocol, local_addr=("127.0.0.1" if host in ("127.0.0.1", "localhost") else "0.0.0.0", 0)
+    )
+    return UDPEndpoint(transport, protocol, is_server=False, remote=(host, port))

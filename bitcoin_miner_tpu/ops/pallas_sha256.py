@@ -1,0 +1,207 @@
+"""Pallas tier of the SHA-256 min-hash sweep (SURVEY §7 B6).
+
+Why Pallas: the jnp tier's unrolled 64-round graph does not stay fused on
+TPU — XLA materialises (B, N) uint32 intermediates to HBM between fusions,
+capping throughput at ~2e7 nonce/s.  Here each grid program hashes a tile of
+lanes entirely in VMEM/vector registers: inputs are a handful of scalar
+template words (SMEM) plus the precomputed low-digit ASCII contribution
+tiles (VMEM, ~12 B/nonce streamed), and the *entire grid* accumulates one
+global running minimum into three SMEM scalars — TPU grid programs run
+sequentially on the core, so cross-program read-modify-write of the output
+ref is well-defined.  The hot loop never touches HBM.
+
+Dispatch-count matters as much as kernel speed: on remote-tunnelled TPUs a
+dispatch + result fetch costs O(100 ms), so a call processes a *super-batch*
+of up to ``batch`` chunks (grid axis 0) × ``10^k`` lanes each (grid axis 1
+tiles) — about 10^9 nonces per dispatch at batch=1024, k=6 — and returns
+just ``(min_h0, min_h1, argmin_flat)``.
+
+Work decomposition matches ops/sweep.py: chunks are 10^k-aligned so high
+digits are per-chunk template constants (host-folded); the k low digits'
+ASCII contribution (pre-shifted into word positions) is a per-class device
+constant computed once with plain XLA ops — identical for every chunk.
+In-kernel div/mod-10 is avoided entirely (Mosaic lowers integer division
+poorly).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .sha256 import DigitPos, compress
+
+U32_MAX = 0xFFFFFFFF
+I32_MAX = 0x7FFFFFFF
+
+# Lanes per grid program: (tile/128, 128) uint32 vectors; 24 live values at
+# tile=8192 is ~768 KiB of VMEM-backed registers.
+DEFAULT_TILE = 8192
+# Chunks per dispatch (grid axis 0). 1024 chunks x 10^6 lanes ~ 1e9 nonces
+# per dispatch; SMEM footprint = batch * (n_words + 2) * 4 B.
+DEFAULT_BATCH = 1024
+
+
+def _contrib_words(low_pos: Sequence[DigitPos]) -> Tuple[int, ...]:
+    """Distinct tail-word indices touched by the k low (in-kernel) digits."""
+    return tuple(sorted({dp.word for dp in low_pos}))
+
+
+@functools.lru_cache(maxsize=64)
+def _digit_contrib_np(
+    k: int, low_pos: Tuple[DigitPos, ...], n_pad: int
+) -> Tuple[np.ndarray, ...]:
+    """(n_pad/128, 128) uint32 per touched word: OR-able ASCII contribution
+    of lane i's k low decimal digits.  Host numpy (converted to an on-device
+    constant inside each jit trace — caching device arrays here would leak
+    tracers)."""
+    i = np.arange(n_pad, dtype=np.int64)
+    per_word: Dict[int, np.ndarray] = {}
+    for j, dp in enumerate(low_pos):
+        p = 10 ** (k - 1 - j)
+        dig = ((i // p) % 10 + 48).astype(np.uint32) << np.uint32(dp.shift)
+        per_word[dp.word] = per_word.get(dp.word, np.uint32(0)) | dig
+    return tuple(
+        per_word[w].reshape(n_pad // 128, 128) for w in _contrib_words(low_pos)
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def make_pallas_minhash(
+    n_tail_blocks: int,
+    low_pos: Tuple[DigitPos, ...],
+    k: int,
+    batch: int = DEFAULT_BATCH,
+    tile: int = DEFAULT_TILE,
+    interpret: bool = False,
+):
+    """Build the jitted Pallas min-hash for one (layout, k, batch) class.
+
+    Returned fn: ``(midstate (8,), tail_const (B, nw), bounds (B, 2))
+    -> (min_h0, min_h1, flat_idx)`` — the global lexicographic min over the
+    whole (B, 10^k) lane grid (hashes in the sign-flipped-int32 domain are
+    compared; outputs are plain uint32), flat_idx = chunk_row * 10^k + lane,
+    I32_MAX when every lane is masked out by bounds.
+    """
+    n_lanes = 10**k
+    # Small chunks (k <= 3) fit one sub-tile; clamp tile to the padded lane
+    # count so we never build a grid of empty programs.
+    tile = max(1024, min(tile, math.ceil(n_lanes / 1024) * 1024))
+    n_tiles = math.ceil(n_lanes / tile)
+    n_pad = n_tiles * tile
+    sub = tile // 128
+    cwords = _contrib_words(low_pos)
+    word_to_cidx = {w: m for m, w in enumerate(cwords)}
+
+    n_words = n_tail_blocks * 16
+
+    def kernel(midstate_ref, tailc_ref, *rest):
+        # tailc_ref row layout: [word_0 .. word_{nw-1}, lo_off, hi_off] — one
+        # combined SMEM table because SMEM pads each window row to 512 B and
+        # separate template/bounds tables would exhaust the 1 MiB budget.
+        contrib_refs = rest[: len(cwords)]
+        h0_ref, h1_ref, idx_ref = rest[len(cwords) :]
+        b = pl.program_id(0)
+        t = pl.program_id(1)
+        lo = tailc_ref[b, n_words].astype(jnp.int32)
+        hi = tailc_ref[b, n_words + 1].astype(jnp.int32)
+
+        # First program initialises the global accumulator to "no result".
+        @pl.when((b == 0) & (t == 0))
+        def _init():
+            h0_ref[0] = jnp.int32(I32_MAX)
+            h1_ref[0] = jnp.int32(I32_MAX)
+            idx_ref[0] = jnp.int32(I32_MAX)
+
+        # Padding rows of a partial super-batch carry bounds (0, 0): skip
+        # their vector work entirely with a scalar branch.
+        @pl.when(hi > lo)
+        def _work():
+            row = jax.lax.broadcasted_iota(jnp.int32, (sub, 128), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (sub, 128), 1)
+            i = t * tile + row * 128 + col  # lane index within this chunk
+
+            state = tuple(midstate_ref[s] for s in range(8))
+            for blk in range(n_tail_blocks):
+                w = []
+                for widx in range(blk * 16, (blk + 1) * 16):
+                    base = tailc_ref[b, widx]
+                    if widx in word_to_cidx:
+                        w.append(contrib_refs[word_to_cidx[widx]][...] | base)
+                    else:
+                        w.append(jnp.full((sub, 128), base, dtype=jnp.uint32))
+                state = compress(state, w)
+
+            valid = (i >= lo) & (i < hi)
+            h0 = jnp.where(valid, state[0], jnp.uint32(U32_MAX))
+            h1 = jnp.where(valid, state[1], jnp.uint32(U32_MAX))
+
+            # Mosaic has no unsigned reductions: compare in the sign-flipped
+            # int32 domain, where u32 order == s32 order (x ^ 0x8000_0000).
+            sbit = jnp.uint32(0x80000000)
+            h0b = jax.lax.bitcast_convert_type(h0 ^ sbit, jnp.int32)
+            h1b = jax.lax.bitcast_convert_type(h1 ^ sbit, jnp.int32)
+            min_h0 = jnp.min(h0b)
+            e0 = h0b == min_h0
+            min_h1 = jnp.min(jnp.where(e0, h1b, jnp.int32(I32_MAX)))
+            e1 = e0 & (h1b == min_h1) & valid
+            gflat = b * n_lanes + i
+            idx = jnp.min(jnp.where(e1, gflat, jnp.int32(I32_MAX)))
+
+            # Fold this program's local min into the single global
+            # accumulator.  Grid programs execute sequentially per core, so
+            # read-modify-write of the SMEM output scalars is safe.
+            p0 = h0_ref[0]
+            p1 = h1_ref[0]
+            pi = idx_ref[0]
+            better = (min_h0 < p0) | (
+                (min_h0 == p0)
+                & ((min_h1 < p1) | ((min_h1 == p1) & (idx < pi)))
+            )
+            h0_ref[0] = jnp.where(better, min_h0, p0)
+            h1_ref[0] = jnp.where(better, min_h1, p1)
+            idx_ref[0] = jnp.where(better, idx, pi)
+
+    grid = (batch, n_tiles)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # midstate (8,)
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # tail_const+bounds (B, nw+2)
+    ] + [
+        pl.BlockSpec((sub, 128), lambda b, t: (t, 0), memory_space=pltpu.VMEM)
+        for _ in cwords
+    ]
+    out_specs = [pl.BlockSpec(memory_space=pltpu.SMEM) for _ in range(3)]
+    out_shape = [
+        jax.ShapeDtypeStruct((1,), jnp.int32),  # sign-flipped h0
+        jax.ShapeDtypeStruct((1,), jnp.int32),  # sign-flipped h1
+        jax.ShapeDtypeStruct((1,), jnp.int32),
+    ]
+
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )
+
+    @jax.jit
+    def minhash(midstate, tailc_bounds):
+        contribs = tuple(
+            jnp.asarray(c) for c in _digit_contrib_np(k, low_pos, n_pad)
+        )
+        h0b, h1b, idx = call(midstate, tailc_bounds, *contribs)
+        sbit = jnp.uint32(0x80000000)
+        min_h0 = jax.lax.bitcast_convert_type(h0b[0], jnp.uint32) ^ sbit
+        min_h1 = jax.lax.bitcast_convert_type(h1b[0], jnp.uint32) ^ sbit
+        return min_h0, min_h1, idx[0]
+
+    return minhash

@@ -1,0 +1,262 @@
+"""Blockwise nonce-range sweep: decomposition + jitted min-hash kernel.
+
+This is the TPU-native replacement for the reference miner's scalar hot loop
+(``bitcoin/miner/miner.go`` intended behavior: ``for n in [lo,hi]:
+h = Hash(data, n); track min`` — SURVEY §3.6).  The "long dimension" here is
+the nonce space (up to 2^64, ``bitcoin/message.go:21``), swept blockwise with
+O(1) device state per chunk — the same pattern long-context frameworks use
+for sequence parallelism, applied to the nonce axis.
+
+Decomposition invariants:
+
+- Nonces are grouped by decimal **digit count** ``d`` (the hashed string's
+  length depends on it), then into **10^k-aligned chunks** so the high
+  ``d-k`` digits are constant per chunk and can be folded into the message
+  template host-side; only the low ``k`` digits vary in-kernel, generated
+  from a lane iota by div/mod-10 (all < 2^31, safe in int32).
+- A kernel call processes a batch of B chunks at once (shape ``(B, 10^k)``),
+  returning the lexicographic min of the big-endian ``(h0, h1)`` hash pair
+  and the flat argmin lane, lowest-nonce tie-break.  Batches are dispatched
+  asynchronously so the device pipeline stays full while the host prepares
+  the next templates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache, partial
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sha256 import DigitPos, MsgLayout, build_layout, compress
+
+U32_MAX = 0xFFFFFFFF
+I32_MAX = 0x7FFFFFFF
+
+
+# --------------------------------------------------------------------------
+# Range decomposition (host)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Chunk:
+    """A 10^k-aligned slice of a digit bucket: nonces ``base + [lo_off,
+    hi_off)`` all share the same decimal digit count and high digits."""
+
+    base: int
+    lo_off: int
+    hi_off: int  # exclusive
+
+
+@dataclass(frozen=True)
+class ChunkGroup:
+    """Chunks sharing digit count ``d`` and low-digit count ``k`` (and hence
+    one compiled kernel + one message layout)."""
+
+    d: int
+    k: int
+    chunks: Tuple[Chunk, ...]
+
+
+def decompose_range(lower: int, upper: int, max_k: int = 6) -> Iterator[ChunkGroup]:
+    """Split inclusive ``[lower, upper]`` into digit-bucketed aligned chunks.
+
+    ``max_k`` caps lanes-per-chunk at 10^max_k; larger buckets become many
+    chunks.  Yields groups in ascending nonce order.
+    """
+    if lower > upper:
+        raise ValueError(f"empty nonce range [{lower}, {upper}]")
+    if lower < 0:
+        raise ValueError(f"negative nonce {lower}")
+    d_lo = len(str(lower))
+    d_hi = len(str(upper))
+    for d in range(d_lo, d_hi + 1):
+        bucket_lo = 0 if d == 1 else 10 ** (d - 1)
+        bucket_hi = 10**d - 1
+        lo = max(lower, bucket_lo)
+        hi = min(upper, bucket_hi)
+        if lo > hi:
+            continue
+        k = 1 if d == 1 else min(d - 1, max_k)
+        span = 10**k
+        chunks = []
+        for c in range(lo // span, hi // span + 1):
+            base = c * span
+            chunks.append(
+                Chunk(base=base, lo_off=max(lo - base, 0), hi_off=min(hi - base + 1, span))
+            )
+        yield ChunkGroup(d=d, k=k, chunks=tuple(chunks))
+
+
+# --------------------------------------------------------------------------
+# The jitted kernel (jnp tier — B6 adds the Pallas tier)
+# --------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=256)
+def _make_kernel(n_tail_blocks: int, low_pos: Tuple[DigitPos, ...], k: int, batch: int):
+    """Compile a min-hash kernel for one (layout, k, batch) shape class.
+
+    Returned jitted fn: ``(midstate (8,), tail_const (B, nw), bounds (B, 2))
+    -> (min_h0, min_h1, flat_idx)`` where flat_idx indexes the (B, 10^k)
+    lane grid row-major, or I32_MAX if every lane was masked out.
+    """
+    n_lanes = 10**k
+
+    def kernel(midstate, tail_const, bounds):
+        i = jnp.arange(n_lanes, dtype=jnp.int32)
+        # ASCII of the k low decimal digits of each lane index.
+        contrib = {}
+        for j, dp in enumerate(low_pos):
+            p = 10 ** (k - 1 - j)
+            dig = ((i // p) % 10 + 48).astype(jnp.uint32) << jnp.uint32(dp.shift)
+            contrib[dp.word] = contrib[dp.word] | dig if dp.word in contrib else dig
+
+        state = tuple(midstate[s] for s in range(8))  # scalars, broadcast below
+        for b in range(n_tail_blocks):
+            w = []
+            for widx in range(b * 16, (b + 1) * 16):
+                col = tail_const[:, widx][:, None]  # (B, 1)
+                if widx in contrib:
+                    w.append(col | contrib[widx][None, :])  # (B, N)
+                else:
+                    w.append(col)
+            state = compress(state, w)
+        h0 = jnp.broadcast_to(state[0], (batch, n_lanes))
+        h1 = jnp.broadcast_to(state[1], (batch, n_lanes))
+
+        valid = (i[None, :] >= bounds[:, :1]) & (i[None, :] < bounds[:, 1:2])
+        h0 = jnp.where(valid, h0, jnp.uint32(U32_MAX))
+        h1 = jnp.where(valid, h1, jnp.uint32(U32_MAX))
+
+        h0f = h0.reshape(-1)
+        h1f = h1.reshape(-1)
+        validf = valid.reshape(-1)
+        flat = jnp.arange(batch * n_lanes, dtype=jnp.int32)
+
+        min_h0 = jnp.min(h0f)
+        e0 = h0f == min_h0
+        h1m = jnp.where(e0, h1f, jnp.uint32(U32_MAX))
+        min_h1 = jnp.min(h1m)
+        e1 = e0 & (h1f == min_h1) & validf
+        flat_idx = jnp.min(jnp.where(e1, flat, jnp.int32(I32_MAX)))
+        return min_h0, min_h1, flat_idx
+
+    return jax.jit(kernel)
+
+
+@lru_cache(maxsize=256)
+def _layout_cache(data: bytes, d: int) -> MsgLayout:
+    return build_layout(data, d)
+
+
+def _fill_templates(
+    layout: MsgLayout, group: ChunkGroup, chunk_rows: Sequence[Chunk], batch: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side: fold each chunk's constant high digits into the word
+    template; build the (B, 2) lane-bound array, padding unused rows empty."""
+    tail_const = np.tile(
+        np.array(layout.tail_template, dtype=np.uint64), (batch, 1)
+    )  # u64 scratch to avoid overflow warnings, cast at the end
+    bounds = np.zeros((batch, 2), dtype=np.int32)
+    span = 10**group.k
+    n_high = layout.digit_count - group.k
+    for r, ch in enumerate(chunk_rows):
+        if n_high > 0:
+            high = str(ch.base // span)
+            assert len(high) == n_high, (high, n_high, ch)
+            for j, ch_digit in enumerate(high):
+                dp = layout.digit_pos[j]
+                tail_const[r, dp.word] |= ord(ch_digit) << dp.shift
+        bounds[r] = (ch.lo_off, ch.hi_off)
+    return tail_const.astype(np.uint32), bounds
+
+
+# --------------------------------------------------------------------------
+# Host sweep driver
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class SweepResult:
+    hash: int  # the 64-bit big-endian hash value
+    nonce: int
+    lanes_swept: int  # valid nonces hashed (for throughput accounting)
+
+
+def _default_backend() -> str:
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def sweep_min_hash(
+    data: str,
+    lower: int,
+    upper: int,
+    *,
+    max_k: int = 6,
+    batch: Optional[int] = None,
+    backend: Optional[str] = None,
+    interpret: bool = False,
+) -> SweepResult:
+    """Find ``(min Hash(data, n), argmin n)`` over inclusive ``[lower,
+    upper]`` on the default JAX device.  Bit-exact vs the hashlib oracle
+    (``bitcoin_miner_tpu.bitcoin.hash_nonce``); ties -> lowest nonce.
+
+    ``backend``: "pallas" (VMEM-resident kernel, the fast TPU path), "xla"
+    (plain fused jnp — reference tier, also the CPU path), or None for
+    auto (pallas on TPU).  ``interpret`` runs Pallas in interpreter mode
+    (for CPU tests of the Pallas tier).
+
+    ``batch`` = chunks per dispatch.  Dispatch+fetch latency on tunnelled
+    TPUs is O(100 ms), so the pallas tier defaults to a large super-batch
+    (~1e9 nonces/dispatch); padding rows are skipped in-kernel.
+    """
+    if backend is None:
+        backend = _default_backend()
+    if batch is None:
+        batch = 1024 if backend == "pallas" else 8
+    data_bytes = data.encode("utf-8")
+    pending: List[Tuple] = []
+    lanes = 0
+    for group in decompose_range(lower, upper, max_k=max_k):
+        layout = _layout_cache(data_bytes, group.d)
+        low_pos = layout.digit_pos[layout.digit_count - group.k :]
+        if backend == "pallas":
+            from .pallas_sha256 import make_pallas_minhash
+
+            kern = make_pallas_minhash(
+                layout.n_tail_blocks, low_pos, group.k, batch, interpret=interpret
+            )
+        else:
+            kern = _make_kernel(layout.n_tail_blocks, low_pos, group.k, batch)
+        midstate = jnp.asarray(np.array(layout.midstate, dtype=np.uint32))
+        for s in range(0, len(group.chunks), batch):
+            rows = group.chunks[s : s + batch]
+            tail_const, bounds = _fill_templates(layout, group, rows, batch)
+            if backend == "pallas":
+                tailcb = np.concatenate(
+                    [tail_const, bounds.astype(np.uint32)], axis=1
+                )
+                out = kern(midstate, jnp.asarray(tailcb))
+            else:
+                out = kern(midstate, jnp.asarray(tail_const), jnp.asarray(bounds))
+            bases = [c.base for c in rows]
+            pending.append((out, bases, 10**group.k))
+            lanes += sum(c.hi_off - c.lo_off for c in rows)
+
+    best: Optional[Tuple[int, int]] = None  # (hash, nonce)
+    for (h0, h1, flat_idx), bases, n_lanes in pending:
+        fi = int(flat_idx)
+        if fi == I32_MAX:
+            continue  # fully-masked call (shouldn't happen with real chunks)
+        h = (int(h0) << 32) | int(h1)
+        nonce = bases[fi // n_lanes] + fi % n_lanes
+        if best is None or (h, nonce) < best:
+            best = (h, nonce)
+    if best is None:
+        raise RuntimeError("sweep produced no candidates")
+    return SweepResult(hash=best[0], nonce=best[1], lanes_swept=lanes)

@@ -1,0 +1,71 @@
+"""Suite 1 parity: echo correctness (reference lsp/lsp1_test.go).
+
+N clients x M messages, each echoed value verified, under various window
+sizes, message counts and write-drop rates.  TestBasic1-9 / TestSendReceive
+/ TestRobust scenarios (lsp1_test.go:201-335), with counts trimmed to keep
+wall-clock sane at 100 ms epochs.
+"""
+
+import pytest
+
+from bitcoin_miner_tpu import lspnet
+from lsp_harness import TestSystem
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    lspnet.reset_faults()
+    yield
+    lspnet.reset_faults()
+
+
+class TestBasic:
+    def test_basic_1_single_client_single_msg(self):
+        TestSystem(num_clients=1, num_msgs=1, window=1).run_echo()
+
+    def test_basic_2_single_client_many_msgs(self):
+        TestSystem(num_clients=1, num_msgs=100, window=1).run_echo()
+
+    def test_basic_3_two_clients(self):
+        TestSystem(num_clients=2, num_msgs=50, window=1).run_echo()
+
+    def test_basic_4_many_clients(self):
+        TestSystem(num_clients=10, num_msgs=30, window=1).run_echo()
+
+    def test_basic_5_window_10(self):
+        TestSystem(num_clients=3, num_msgs=60, window=10).run_echo()
+
+    def test_basic_6_window_20(self):
+        TestSystem(num_clients=2, num_msgs=100, window=20).run_echo()
+
+class TestSendReceive:
+    """Epochs too long to help: correctness must not depend on
+    retransmission (lsp1_test.go:267-287)."""
+
+    def test_send_receive_no_retransmit(self):
+        TestSystem(
+            num_clients=2, num_msgs=50, window=5,
+            epoch_millis=2000, epoch_limit=5, max_epochs=10,
+        ).run_echo()
+
+
+class TestRobust:
+    """20% write drop, fast epochs (lsp1_test.go:289-335)."""
+
+    def test_robust_1(self):
+        TestSystem(
+            num_clients=1, num_msgs=30, window=1,
+            epoch_millis=50, write_drop=20, max_epochs=400,
+        ).run_echo()
+
+    def test_robust_2_windowed(self):
+        TestSystem(
+            num_clients=2, num_msgs=30, window=5,
+            epoch_millis=50, write_drop=20, max_epochs=400,
+        ).run_echo()
+
+    def test_robust_3_many_clients(self):
+        TestSystem(
+            num_clients=5, num_msgs=20, window=3,
+            epoch_millis=50, write_drop=20, max_epochs=400,
+        ).run_echo()

@@ -1,0 +1,177 @@
+"""Suite 3 parity: close/CloseConn semantics + slow-start
+(reference lsp/lsp3_test.go).
+
+- TestServerSlowStart1-2 (:322-338): the server starts epochs late; the
+  client's Connect-retry loop must still establish the connection
+  (:177-181).
+- TestClientClose1-2 (:340-392): client closes after N echoes; close blocks
+  until pending sends are acked; the server must observe the client's death
+  via a Read error carrying the conn id (:202-207).
+- TestServerCloseConns / TestServerClose: one side closes; the other
+  observes termination via Read error (:302-311).
+- Connect to a dead port fails with CannotEstablishConnection after
+  EpochLimit epochs (lsp/client_impl.go:111-125).
+"""
+
+import time
+
+import pytest
+
+from bitcoin_miner_tpu import lsp, lspnet
+from lsp_harness import random_port, spawn
+
+EPOCH_MS = 100
+
+
+def params(limit=5, w=2):
+    return lsp.Params(epoch_limit=limit, epoch_millis=EPOCH_MS, window_size=w)
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    lspnet.reset_faults()
+    yield
+    lspnet.reset_faults()
+
+
+class TestSlowStart:
+    def test_server_starts_late(self):
+        port = random_port()
+        results = {}
+
+        def connect():
+            try:
+                c = lsp.Client("127.0.0.1", port, params())
+                c.write(b"ping")
+                results["echo"] = c.read()
+                c.close()
+            except lsp.LspError as e:
+                results["err"] = e
+
+        t = spawn(connect)
+        time.sleep(3 * EPOCH_MS / 1000)  # 3 epochs of darkness
+        server = lsp.Server(port, params())
+        cid, payload = server.read()
+        assert payload == b"ping"
+        server.write(cid, payload)
+        t.join(timeout=5)
+        assert results.get("echo") == b"ping", results
+        server.close()
+
+    def test_connect_gives_up_after_epoch_limit(self):
+        t0 = time.time()
+        with pytest.raises(lsp.CannotEstablishConnectionError):
+            lsp.Client("127.0.0.1", random_port(), params(limit=3))
+        elapsed = time.time() - t0
+        # 3 epochs of retries (plus scheduling slack), not forever.
+        assert 2.5 * EPOCH_MS / 1000 <= elapsed <= 20 * EPOCH_MS / 1000, elapsed
+
+
+class TestClientClose:
+    def test_close_drains_pending_sends(self):
+        """Write a burst beyond the window, close immediately: every message
+        must still reach the server (lsp4's FastClose cousin lives in suite
+        4; this is the loss-free drain)."""
+        server = lsp.Server(0, params(w=2))
+        received = []
+
+        def server_loop():
+            while True:
+                try:
+                    _cid, p = server.read()
+                    received.append(p)
+                except lsp.ConnLostError:
+                    continue
+                except lsp.LspError:
+                    return
+
+        spawn(server_loop)
+        client = lsp.Client("127.0.0.1", server.port, params(w=2))
+        total = 20
+        for i in range(total):
+            client.write(b"x%d" % i)
+        client.close()  # must block until all 20 are acked
+        deadline = time.time() + 1.0
+        while len(received) < total and time.time() < deadline:
+            time.sleep(0.01)
+        assert received == [b"x%d" % i for i in range(total)]
+        server.close()
+
+    def test_server_detects_client_death(self):
+        server = lsp.Server(0, params(limit=3))
+        client = lsp.Client("127.0.0.1", server.port, params(limit=3))
+        client.write(b"hello")
+        cid, _ = server.read()
+        client.close()
+        # After the client goes silent, the server must surface the loss as
+        # a Read error carrying the dead conn id (server_api.go:10-16).
+        with pytest.raises(lsp.ConnLostError) as ei:
+            while True:
+                server.read()
+        assert ei.value.conn_id == cid
+        server.close()
+
+    def test_write_after_close_raises(self):
+        server = lsp.Server(0, params())
+        client = lsp.Client("127.0.0.1", server.port, params())
+        client.write(b"a")
+        server.read()
+        client.close()
+        with pytest.raises(lsp.LspError):
+            client.write(b"b")
+        server.close()
+
+
+class TestServerClose:
+    def test_close_conn_terminates_client(self):
+        server = lsp.Server(0, params(limit=3))
+        client = lsp.Client("127.0.0.1", server.port, params(limit=3))
+        client.write(b"hi")
+        cid, _ = server.read()
+        server.close_conn(cid)
+        with pytest.raises(lsp.LspError):
+            while True:
+                client.read()
+        server.close()
+
+    def test_server_close_terminates_all_clients(self):
+        server = lsp.Server(0, params(limit=3))
+        clients = []
+        for _ in range(3):
+            c = lsp.Client("127.0.0.1", server.port, params(limit=3))
+            c.write(b"hi")
+            clients.append(c)
+        seen = set()
+        for _ in range(3):
+            cid, _ = server.read()
+            seen.add(cid)
+        assert len(seen) == 3
+        server.close()
+        # server.read now reports closure
+        with pytest.raises(lsp.ConnClosedError):
+            server.read()
+        # every client observes termination
+        for c in clients:
+            with pytest.raises(lsp.LspError):
+                while True:
+                    c.read()
+
+    def test_server_close_drains_pending_writes(self):
+        """Server writes a burst to a client and closes; the client must
+        still receive everything (drain-before-shutdown)."""
+        server = lsp.Server(0, params(w=2))
+        client = lsp.Client("127.0.0.1", server.port, params(w=2))
+        client.write(b"hi")
+        cid, _ = server.read()
+        total = 15
+        for i in range(total):
+            server.write(cid, b"s%d" % i)
+        server.close()  # blocks until drained
+        got = []
+        try:
+            while len(got) < total:
+                got.append(client.read())
+        except lsp.LspError:
+            pass
+        assert got == [b"s%d" % i for i in range(total)]
+        client.close()

@@ -1,0 +1,191 @@
+"""Suite 4 parity: buffering across network partitions
+(reference lsp/lsp4_test.go).
+
+A partition is faked by flipping the global write-drop knob to 100% on both
+sides (the network-toggler goroutine, lsp4_test.go:113-139).  LSP's send
+buffers must hold everything written during the partition and flush it, in
+order, once the network heals:
+
+- TestServerFastClose1-3 (:444-463): Close while the network is down must
+  still drain once it returns.
+- TestClientToServer / TestServerToClient1-3 (:465-505): bulk streams
+  written entirely during a partition arrive in order after heal.
+- TestRoundTrip1-3 (:507-526): echo traffic across repeated partitions.
+"""
+
+import time
+
+import pytest
+
+from bitcoin_miner_tpu import lsp, lspnet
+from lsp_harness import spawn
+
+EPOCH_MS = 100
+
+
+def params(limit=60, w=32):
+    # Generous epoch limit so connections survive the partitions.
+    return lsp.Params(epoch_limit=limit, epoch_millis=EPOCH_MS, window_size=w)
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    lspnet.reset_faults()
+    yield
+    lspnet.reset_faults()
+
+
+def partition(on: bool) -> None:
+    lspnet.set_write_drop_percent(100 if on else 0)
+
+
+def collecting_server(p):
+    server = lsp.Server(0, p)
+    received = []
+
+    def loop():
+        while True:
+            try:
+                _cid, payload = server.read()
+                received.append(payload)
+            except lsp.ConnLostError:
+                continue
+            except lsp.LspError:
+                return
+
+    spawn(loop)
+    return server, received
+
+
+def test_client_to_server_bulk_during_partition():
+    p = params()
+    server, received = collecting_server(p)
+    client = lsp.Client("127.0.0.1", server.port, p)
+    client.write(b"warm")
+    deadline = time.time() + 2
+    while not received and time.time() < deadline:
+        time.sleep(0.01)
+
+    partition(True)
+    total = 100
+    for i in range(total):
+        client.write(b"p%d" % i)
+    time.sleep(3 * EPOCH_MS / 1000)  # a few epochs of darkness
+    assert received == [b"warm"], received
+    partition(False)
+
+    want = [b"warm"] + [b"p%d" % i for i in range(total)]
+    deadline = time.time() + 50 * EPOCH_MS / 1000
+    while len(received) < len(want) and time.time() < deadline:
+        time.sleep(0.02)
+    assert received == want
+    client.close()
+    server.close()
+
+
+def test_server_to_client_bulk_during_partition():
+    p = params()
+    server = lsp.Server(0, p)
+    client = lsp.Client("127.0.0.1", server.port, p)
+    got = []
+
+    def reader():
+        while True:
+            try:
+                got.append(client.read())
+            except lsp.LspError:
+                return
+
+    spawn(reader)
+    client.write(b"warm")
+    cid, _ = server.read()
+
+    partition(True)
+    total = 100
+    for i in range(total):
+        server.write(cid, b"p%d" % i)
+    time.sleep(3 * EPOCH_MS / 1000)
+    assert got == [], got
+    partition(False)
+
+    want = [b"p%d" % i for i in range(total)]
+    deadline = time.time() + 50 * EPOCH_MS / 1000
+    while len(got) < total and time.time() < deadline:
+        time.sleep(0.02)
+    assert got == want
+    client.close()
+    server.close()
+
+
+def test_client_fast_close_flushes_after_heal():
+    """Close during a partition blocks, then completes once the network
+    returns — and every message makes it (lsp4_test.go:444-463)."""
+    p = params()
+    server, received = collecting_server(p)
+    client = lsp.Client("127.0.0.1", server.port, p)
+
+    partition(True)
+    total = 30
+    for i in range(total):
+        client.write(b"f%d" % i)
+
+    close_done = []
+
+    def closer():
+        client.close()
+        close_done.append(time.time())
+
+    t = spawn(closer)
+    time.sleep(3 * EPOCH_MS / 1000)
+    assert not close_done, "close returned during the partition"
+    partition(False)
+    t.join(timeout=50 * EPOCH_MS / 1000)
+    assert close_done, "close never completed after heal"
+
+    want = [b"f%d" % i for i in range(total)]
+    deadline = time.time() + 10
+    while len(received) < total and time.time() < deadline:
+        time.sleep(0.02)
+    assert received == want
+    server.close()
+
+
+def test_round_trip_across_partitions():
+    """Echo traffic while the network flaps (lsp4_test.go:507-526)."""
+    p = params()
+    server = lsp.Server(0, p)
+
+    def echo_loop():
+        while True:
+            try:
+                cid, payload = server.read()
+                server.write(cid, payload)
+            except lsp.ConnLostError:
+                continue
+            except lsp.LspError:
+                return
+
+    spawn(echo_loop)
+    client = lsp.Client("127.0.0.1", server.port, p)
+
+    flapping = True
+
+    def toggler():
+        on = False
+        while flapping:
+            partition(on)
+            on = not on
+            time.sleep(1.5 * EPOCH_MS / 1000)
+        partition(False)
+
+    t = spawn(toggler)
+    try:
+        for i in range(30):
+            msg = b"rt%d" % i
+            client.write(msg)
+            assert client.read() == msg
+    finally:
+        flapping = False
+        t.join(timeout=2)
+    client.close()
+    server.close()

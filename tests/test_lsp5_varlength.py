@@ -1,0 +1,107 @@
+"""Suite 5 parity: variable-length / corrupt payload handling via the
+``Size`` field (reference lsp/lsp5_test.go).
+
+The lspnet mutator rewrites Data payloads in flight while keeping ``Size``
+intact (lspnet/conn.go:119-146):
+
+- LONG mode (lengthening 100%): payloads arrive with len > Size; the
+  receiver must truncate to exactly Size bytes (lsp5_test.go:40-62).
+- SHORT mode (shortening 100%): payloads arrive with len < Size; the
+  receiver must never surface them to Read (lsp5_test.go:64-85).
+
+The reference implementation itself never validated Size (SURVEY §8.5);
+the tests define the required behavior, which this transport implements.
+"""
+
+import time
+
+import pytest
+
+from bitcoin_miner_tpu import lsp, lspnet
+from lsp_harness import spawn
+
+EPOCH_MS = 100
+PARAMS = lsp.Params(epoch_limit=5, epoch_millis=EPOCH_MS, window_size=4)
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    lspnet.reset_faults()
+    yield
+    lspnet.reset_faults()
+
+
+def test_lengthened_messages_truncated_to_size_server_side():
+    server = lsp.Server(0, PARAMS)
+    client = lsp.Client("127.0.0.1", server.port, PARAMS)
+    lspnet.set_msg_lengthening_percent(100)
+    for i in range(10):
+        msg = b"value-%d" % i
+        client.write(msg)
+        cid, payload = server.read()
+        # Mutator appended bytes, receiver must truncate to Size exactly.
+        assert payload == msg
+    lspnet.reset_faults()
+    client.close()
+    server.close()
+
+
+def test_lengthened_messages_truncated_to_size_client_side():
+    server = lsp.Server(0, PARAMS)
+    client = lsp.Client("127.0.0.1", server.port, PARAMS)
+    client.write(b"hello")
+    cid, _ = server.read()
+    lspnet.set_msg_lengthening_percent(100)
+    for i in range(10):
+        msg = b"value-%d" % i
+        server.write(cid, msg)
+        assert client.read() == msg
+    lspnet.reset_faults()
+    client.close()
+    server.close()
+
+
+def test_shortened_messages_never_surface():
+    server = lsp.Server(0, PARAMS)
+    client = lsp.Client("127.0.0.1", server.port, PARAMS)
+    surfaced = []
+
+    def server_loop():
+        while True:
+            try:
+                surfaced.append(server.read()[1])
+            except lsp.ConnLostError:
+                continue
+            except lsp.LspError:
+                return
+
+    spawn(server_loop)
+    lspnet.set_msg_shortening_percent(100)
+    for i in range(5):
+        client.write(b"secret-%d" % i)
+    # Several epochs of retransmission: every copy is shortened in flight,
+    # so nothing may ever reach the application.
+    time.sleep(4 * EPOCH_MS / 1000)
+    assert surfaced == [], surfaced
+    lspnet.reset_faults()
+    # After the network stops corrupting, retransmits deliver everything.
+    deadline = time.time() + 30 * EPOCH_MS / 1000
+    while len(surfaced) < 5 and time.time() < deadline:
+        time.sleep(0.02)
+    assert surfaced == [b"secret-%d" % i for i in range(5)]
+    client.close()
+    server.close()
+
+
+def test_short_single_byte_payload_edge():
+    """A 1-byte payload halves to 0 bytes — still len < Size, still dropped."""
+    server = lsp.Server(0, PARAMS)
+    client = lsp.Client("127.0.0.1", server.port, PARAMS)
+    lspnet.set_msg_shortening_percent(100)
+    client.write(b"x")
+    time.sleep(3 * EPOCH_MS / 1000)
+    lspnet.reset_faults()
+    cid, payload = server.read()
+    assert payload == b"x"
+    client.close()
+    server.close()
