@@ -1,0 +1,16 @@
+"""Application binaries (L4): mining server / miner / client + echo runners.
+
+Run as modules::
+
+    python -m bitcoin_miner_tpu.apps.server <port>
+    python -m bitcoin_miner_tpu.apps.miner  <host:port> [--backend ...] [--devices N]
+    python -m bitcoin_miner_tpu.apps.client <host:port> <message> <maxNonce>
+    python -m bitcoin_miner_tpu.apps.srunner / .crunner   (echo harnesses)
+
+CLI + stdout contracts mirror the reference binaries
+(``bitcoin/{server,miner,client}``, ``srunner``, ``crunner``).
+"""
+
+from .scheduler import Scheduler
+
+__all__ = ["Scheduler"]

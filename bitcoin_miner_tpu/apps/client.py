@@ -1,0 +1,64 @@
+"""The request client binary.
+
+CLI + stdout parity with the reference (``bitcoin/client/client.go:12-48``,
+frozen contract): ``client <hostport> <message> <maxNonce>`` prints exactly
+``Result <hash> <nonce>`` on success or ``Disconnected`` if the server
+connection is lost before the result arrives.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional, TextIO, Tuple
+
+from .. import lsp
+from ..bitcoin.message import Message, MsgType
+
+
+def request_once(
+    client: "lsp.Client", message: str, max_nonce: int
+) -> Optional[Tuple[int, int]]:
+    """Send the job and block for its Result; None if the conn is lost."""
+    client.write(Message.request(message, 0, max_nonce).marshal())
+    while True:
+        try:
+            payload = client.read()
+        except lsp.LspError:
+            return None
+        msg = Message.unmarshal(payload)
+        if msg is not None and msg.type == MsgType.RESULT:
+            return msg.hash, msg.nonce
+
+
+def main(argv=None, out: TextIO = sys.stdout) -> int:
+    argv = sys.argv if argv is None else argv
+    if len(argv) != 4:
+        print(f"Usage: ./{argv[0]} <hostport> <message> <maxNonce>", end="", file=out)
+        return 0
+    hostport, message = argv[1], argv[2]
+    try:
+        max_nonce = int(argv[3])
+        if max_nonce < 0 or max_nonce >= 1 << 64:
+            raise ValueError
+    except ValueError:
+        print(f"{argv[3]} is not a number.", file=out)
+        return 0
+    host, _, port = hostport.rpartition(":")
+    try:
+        client = lsp.Client(host or "127.0.0.1", int(port))
+    except (lsp.LspError, OSError, ValueError) as e:
+        print("Failed to connect to server:", e, file=out)
+        return 0
+    try:
+        result = request_once(client, message, max_nonce)
+        if result is None:
+            print("Disconnected", file=out)  # client.go:46-48
+        else:
+            print("Result", result[0], result[1], file=out)  # client.go:41-43
+    finally:
+        client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,122 @@
+"""The miner worker binary: Join, then Request→sweep→Result forever.
+
+CLI parity with the reference stub (``bitcoin/miner/miner.go:18-24``):
+``miner <hostport>``; the reference's intended loop (SURVEY §3.6) is
+implemented with the hash search running on one of three backends:
+
+- ``pallas``  — the VMEM-resident TPU kernel (default on TPU)
+- ``xla``     — fused jnp tier (default elsewhere; also runs on CPU/GPU)
+- ``cpu``     — scalar hashlib loop, byte-identical to the Go reference
+  miner's hot loop; exists so heterogeneous fleets (Go-like CPU miners +
+  TPU miners) exercise the same scheduler path (BASELINE.json config 3)
+
+``--devices N`` spans the sweep over an N-chip mesh via shard_map +
+collective min (parallel/sweep.py); the process still presents one worker
+to the scheduler — multi-chip is invisible at the protocol boundary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Callable, Optional, Tuple
+
+from .. import lsp
+from ..bitcoin.hash import min_hash_range
+from ..bitcoin.message import Message, MsgType
+
+SearchFn = Callable[[str, int, int], Tuple[int, int]]  # -> (hash, nonce)
+
+
+def make_search(backend: str = "auto", devices: Optional[int] = None) -> SearchFn:
+    """Build the (data, lower, upper) -> (min_hash, nonce) search function."""
+    if backend == "cpu":
+        if devices is not None and devices != 1:
+            raise ValueError(
+                "--devices requires a JAX backend (xla/pallas); "
+                "--backend cpu is the scalar oracle loop"
+            )
+        return min_hash_range
+    if backend == "auto":
+        backend = None  # let the ops layer pick pallas-on-TPU / xla elsewhere
+    if devices is not None and devices != 1:
+        if devices < 1:
+            raise ValueError(f"--devices must be >= 1, got {devices}")
+        from ..parallel import default_mesh, sweep_min_hash_sharded
+
+        mesh = default_mesh(devices)
+
+        def search(data: str, lower: int, upper: int) -> Tuple[int, int]:
+            r = sweep_min_hash_sharded(data, lower, upper, mesh=mesh, backend=backend)
+            return r.hash, r.nonce
+
+        return search
+
+    from ..ops.sweep import sweep_min_hash
+
+    def search(data: str, lower: int, upper: int) -> Tuple[int, int]:
+        r = sweep_min_hash(data, lower, upper, backend=backend)
+        return r.hash, r.nonce
+
+    return search
+
+
+def run_miner(
+    client: "lsp.Client", search: SearchFn
+) -> None:
+    """Join and serve Requests until the server connection dies (the
+    reference miner's intended lifetime: exit on server loss)."""
+    client.write(Message.join().marshal())
+    while True:
+        try:
+            payload = client.read()
+        except lsp.LspError:
+            return  # server lost/closed → miner exits
+        msg = Message.unmarshal(payload)
+        if msg is None or msg.type != MsgType.REQUEST:
+            continue
+        try:
+            h, n = search(msg.data, msg.lower, msg.upper)
+        except Exception as e:
+            # A broken backend (e.g. pallas without a TPU) must not dump a
+            # traceback mid-protocol; exit cleanly so the server reassigns.
+            print(f"miner: search failed: {e!r}", file=sys.stderr)
+            return
+        try:
+            client.write(Message.result(h, n).marshal())
+        except lsp.LspError:
+            return
+
+
+def main(argv=None) -> int:
+    argv = sys.argv if argv is None else argv
+    if len(argv) < 2:
+        print(f"Usage: ./{argv[0]} <hostport>", end="")
+        return 0
+    parser = argparse.ArgumentParser(prog=argv[0], add_help=False)
+    parser.add_argument("hostport")
+    parser.add_argument(
+        "--backend", choices=["auto", "pallas", "xla", "cpu"], default="auto"
+    )
+    parser.add_argument("--devices", type=int, default=None)
+    args = parser.parse_args(argv[1:])
+    try:
+        search = make_search(args.backend, args.devices)
+    except ValueError as e:
+        print("Invalid miner configuration:", e)
+        return 0
+    host, _, port = args.hostport.rpartition(":")
+    try:
+        client = lsp.Client(host or "127.0.0.1", int(port))
+    except (lsp.LspError, OSError, ValueError) as e:
+        print("Failed to join with server:", e)
+        return 0
+    try:
+        run_miner(client, search)
+    finally:
+        client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,212 @@
+"""The mining scheduler: pure event-driven job-splitting logic.
+
+This is the brain of the server binary — the reference left it as a stub
+(``bitcoin/server/server.go:16-20`` is ``TODO``), so this implements the
+behavior its frozen contracts imply (SURVEY §3.6): register miners on
+``Join``, split each client ``Request``'s nonce range into chunks across
+live miners, min-fold ``Result``s, reassign a dead miner's outstanding
+chunk, drop jobs of dead clients.
+
+Design notes (deliberately not a translation of anything):
+
+- **Transport-agnostic.** Every event method takes ids + a ``now``
+  timestamp and returns a list of ``(conn_id, Message)`` sends for the
+  caller to put on the wire.  The LSP server loop (apps/server.py) is a
+  thin shell; all policy lives here and is unit-tested without sockets.
+- **Throughput-adaptive chunking.** A TPU miner is ~10^3-10^4× faster
+  than a CPU one, so fixed chunks either starve the TPU or straggle on the
+  CPU.  Jobs keep *interval* work lists (not pre-cut chunks); each
+  assignment carves a chunk sized to the miner's EWMA nonces/sec so every
+  chunk targets ``target_chunk_seconds`` of work.  New miners start at
+  ``min_chunk`` and ramp as rates are observed.
+- **Lowest-nonce tie-break** on equal min-hashes, matching the kernels
+  (BASELINE.md).
+- **Fairness**: round-robin across jobs with pending work.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from ..bitcoin.message import Message
+
+Action = Tuple[int, Message]  # (conn_id, message to send)
+Interval = Tuple[int, int]  # inclusive [lower, upper]
+
+
+@dataclass
+class _Miner:
+    conn_id: int
+    job: Optional[int] = None  # client conn_id currently served
+    interval: Optional[Interval] = None
+    assigned_at: float = 0.0
+    rate: float = 0.0  # EWMA nonces/sec; 0 = unknown
+
+
+@dataclass
+class _Job:
+    client_id: int
+    data: str
+    pending: Deque[Interval] = field(default_factory=deque)
+    outstanding: Dict[int, Interval] = field(default_factory=dict)
+    best: Optional[Tuple[int, int]] = None  # (hash, nonce)
+
+    def fold(self, hash_: int, nonce: int) -> None:
+        cand = (hash_, nonce)
+        if self.best is None or cand < self.best:
+            self.best = cand
+
+    @property
+    def done(self) -> bool:
+        return not self.pending and not self.outstanding
+
+
+class Scheduler:
+    """Event-in, actions-out mining scheduler (see module docstring)."""
+
+    def __init__(
+        self,
+        *,
+        min_chunk: int = 50_000,
+        max_chunk: int = 10**9,
+        target_chunk_seconds: float = 0.5,
+        rate_alpha: float = 0.5,
+    ) -> None:
+        self.min_chunk = min_chunk
+        self.max_chunk = max_chunk
+        self.target_chunk_seconds = target_chunk_seconds
+        self.rate_alpha = rate_alpha
+        self.miners: Dict[int, _Miner] = {}
+        self.jobs: Dict[int, _Job] = {}
+        self._job_rr: Deque[int] = deque()  # round-robin order of job ids
+
+    # ------------------------------------------------------------------ events
+
+    def miner_joined(self, conn_id: int, now: float = 0.0) -> List[Action]:
+        if conn_id in self.miners or conn_id in self.jobs:
+            return []  # duplicate Join / role confusion: ignore
+        self.miners[conn_id] = _Miner(conn_id)
+        return self._dispatch(now)
+
+    def client_request(
+        self, conn_id: int, data: str, lower: int, upper: int, now: float = 0.0
+    ) -> List[Action]:
+        if conn_id in self.jobs or conn_id in self.miners:
+            return []  # one job per client conn; ignore repeats
+        if lower < 0 or upper >= 1 << 64:
+            return []  # defense in depth; Message.unmarshal already rejects
+        job = _Job(client_id=conn_id, data=data)
+        if lower <= upper:
+            job.pending.append((lower, upper))
+        self.jobs[conn_id] = job
+        self._job_rr.append(conn_id)
+        if job.done:  # degenerate empty range: answer immediately
+            del self.jobs[conn_id]
+            self._job_rr.remove(conn_id)
+            return [(conn_id, Message.result(0, 0))]
+        return self._dispatch(now)
+
+    def result(
+        self, conn_id: int, hash_: int, nonce: int, now: float = 0.0
+    ) -> List[Action]:
+        miner = self.miners.get(conn_id)
+        if miner is None or miner.interval is None:
+            return []  # Result from a non-miner or an unassigned miner
+        lo, hi = miner.interval
+        elapsed = max(now - miner.assigned_at, 1e-6)
+        sample = (hi - lo + 1) / elapsed
+        miner.rate = (
+            sample
+            if miner.rate == 0.0
+            else self.rate_alpha * sample + (1 - self.rate_alpha) * miner.rate
+        )
+        job = self.jobs.get(miner.job)  # None if the client died meanwhile
+        miner.job = None
+        miner.interval = None
+        actions: List[Action] = []
+        if job is not None:
+            job.outstanding.pop(conn_id, None)
+            job.fold(hash_, nonce)
+            if job.done:
+                actions.append(self._finish_job(job))
+        actions.extend(self._dispatch(now))
+        return actions
+
+    def lost(self, conn_id: int, now: float = 0.0) -> List[Action]:
+        """A connection died — miner or client, we find out here."""
+        miner = self.miners.pop(conn_id, None)
+        if miner is not None:
+            job = self.jobs.get(miner.job) if miner.job is not None else None
+            if job is not None and miner.interval is not None:
+                # Reassign: return the chunk to the *front* so low nonces
+                # stay first (keeps the lowest-nonce tie-break cheap).
+                job.outstanding.pop(conn_id, None)
+                job.pending.appendleft(miner.interval)
+            return self._dispatch(now)
+        job = self.jobs.pop(conn_id, None)
+        if job is not None:
+            if conn_id in self._job_rr:
+                self._job_rr.remove(conn_id)
+            # Outstanding miners keep crunching; their Results will find no
+            # job and simply idle them (see result()).
+        return []
+
+    # ------------------------------------------------------------------ internals
+
+    def _finish_job(self, job: _Job) -> Action:
+        del self.jobs[job.client_id]
+        self._job_rr.remove(job.client_id)
+        assert job.best is not None
+        return (job.client_id, Message.result(job.best[0], job.best[1]))
+
+    def _chunk_size(self, miner: _Miner) -> int:
+        if miner.rate <= 0.0:
+            return self.min_chunk
+        size = int(miner.rate * self.target_chunk_seconds)
+        return max(self.min_chunk, min(size, self.max_chunk))
+
+    def _next_job(self) -> Optional[_Job]:
+        """Round-robin over jobs that still have pending work."""
+        for _ in range(len(self._job_rr)):
+            cid = self._job_rr[0]
+            self._job_rr.rotate(-1)
+            job = self.jobs[cid]
+            if job.pending:
+                return job
+        return None
+
+    def _dispatch(self, now: float) -> List[Action]:
+        actions: List[Action] = []
+        idle = [m for m in self.miners.values() if m.job is None]
+        # Fastest miners first: they drain the most work per assignment.
+        idle.sort(key=lambda m: -m.rate)
+        for miner in idle:
+            job = self._next_job()
+            if job is None:
+                break
+            lo, hi = job.pending.popleft()
+            size = self._chunk_size(miner)
+            cut = min(hi, lo + size - 1)
+            if cut < hi:
+                job.pending.appendleft((cut + 1, hi))
+            miner.job = job.client_id
+            miner.interval = (lo, cut)
+            miner.assigned_at = now
+            job.outstanding[miner.conn_id] = (lo, cut)
+            actions.append((miner.conn_id, Message.request(job.data, lo, cut)))
+        return actions
+
+    # ------------------------------------------------------------------ metrics
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "miners": len(self.miners),
+            "idle_miners": sum(1 for m in self.miners.values() if m.job is None),
+            "jobs": len(self.jobs),
+            "pending_intervals": sum(len(j.pending) for j in self.jobs.values()),
+            "outstanding_chunks": sum(
+                len(j.outstanding) for j in self.jobs.values()
+            ),
+        }

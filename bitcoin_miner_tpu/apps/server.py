@@ -1,0 +1,106 @@
+"""The mining server binary: LSP shell around the Scheduler.
+
+CLI parity with the reference stub (``bitcoin/server/server.go:41-51``):
+``server <port>``, prints ``Server listening on port <port>``, logs to
+``log.txt``.  The reference left the body as ``TODO``; the implemented
+behavior follows its frozen contracts (SURVEY §3.6).
+
+The shell is a single blocking read loop: LSP's multiplexed ``read()``
+yields ``(conn_id, payload)`` or raises ``ConnLostError`` with the dead
+conn's id (our fix of reference quirk §8.3 is what makes clean miner/client
+death handling possible at all).  Every event is handed to the pure
+:class:`~bitcoin_miner_tpu.apps.scheduler.Scheduler`, whose returned
+actions are put on the wire.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Optional
+
+from .. import lsp
+from ..bitcoin.message import Message, MsgType
+from .scheduler import Scheduler
+
+
+def serve(
+    server: "lsp.Server",
+    scheduler: Optional[Scheduler] = None,
+    log: Optional[logging.Logger] = None,
+    clock=time.monotonic,
+) -> None:
+    """Run the scheduler loop over an already-listening LSP server until the
+    server is closed.  Factored out of main() so tests drive it in-process.
+    """
+    sched = scheduler if scheduler is not None else Scheduler()
+    log = log or logging.getLogger("bitcoin_miner_tpu.server")
+
+    def emit(actions) -> None:
+        for conn_id, msg in actions:
+            try:
+                server.write(conn_id, msg.marshal())
+            except lsp.LspError:
+                # Connection died between scheduling and sending; the loss
+                # event will arrive via read() and trigger reassignment.
+                log.info("write to %d failed (conn dead)", conn_id)
+
+    while True:
+        try:
+            conn_id, payload = server.read()
+        except lsp.ConnLostError as e:
+            log.info("connection %d lost", e.conn_id)
+            emit(sched.lost(e.conn_id, clock()))
+            continue
+        except lsp.ConnClosedError:
+            return
+        msg = Message.unmarshal(payload)
+        if msg is None:
+            log.warning("undecodable payload from %d", conn_id)
+            continue
+        now = clock()
+        if msg.type == MsgType.JOIN:
+            log.info("miner %d joined", conn_id)
+            emit(sched.miner_joined(conn_id, now))
+        elif msg.type == MsgType.REQUEST:
+            log.info(
+                "request from %d: data=%r range=[%d,%d]",
+                conn_id, msg.data, msg.lower, msg.upper,
+            )
+            emit(sched.client_request(conn_id, msg.data, msg.lower, msg.upper, now))
+        elif msg.type == MsgType.RESULT:
+            emit(sched.result(conn_id, msg.hash, msg.nonce, now))
+
+
+def main(argv=None) -> int:
+    argv = sys.argv if argv is None else argv
+    # Parity: reference logs to ./log.txt (bitcoin/server/server.go:26-39).
+    logging.basicConfig(
+        filename="log.txt",
+        level=logging.INFO,
+        format="%(asctime)s %(filename)s:%(lineno)d %(message)s",
+    )
+    if len(argv) != 2:
+        print(f"Usage: ./{argv[0]} <port>", end="")
+        return 0
+    try:
+        port = int(argv[1])
+    except ValueError as e:
+        print("Port must be a number:", e)
+        return 0
+    try:
+        server = lsp.Server(port)
+    except OSError as e:
+        print(str(e))
+        return 0
+    print("Server listening on port", port)
+    try:
+        serve(server)
+    finally:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,13 +65,31 @@ class Message:
             obj = json.loads(buf.decode("utf-8"))
             if not isinstance(obj, dict):
                 return None
+            u64s = []
+            for field in ("Lower", "Upper", "Hash", "Nonce"):
+                v = obj.get(field, 0)
+                # Go json.Unmarshal rejects non-integer or out-of-range
+                # values for uint64 struct fields; a poison Request must not
+                # reach the scheduler (it would crash every miner it is
+                # assigned to).
+                if isinstance(v, bool) or not isinstance(v, int):
+                    return None
+                if v < 0 or v > U64_MASK:
+                    return None
+                u64s.append(v)
+            type_ = obj.get("Type", 0)
+            if isinstance(type_, bool) or not isinstance(type_, int):
+                return None
+            data = obj.get("Data", "")
+            if not isinstance(data, str):
+                return None  # Go rejects non-string JSON for a string field
             return Message(
-                type=MsgType(int(obj.get("Type", 0))),
-                data=str(obj.get("Data", "")),
-                lower=int(obj.get("Lower", 0)),
-                upper=int(obj.get("Upper", 0)),
-                hash=int(obj.get("Hash", 0)),
-                nonce=int(obj.get("Nonce", 0)),
+                type=MsgType(type_),
+                data=data,
+                lower=u64s[0],
+                upper=u64s[1],
+                hash=u64s[2],
+                nonce=u64s[3],
             )
         except (ValueError, TypeError, UnicodeDecodeError):
             return None

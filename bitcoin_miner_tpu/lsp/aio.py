@@ -65,7 +65,12 @@ class AsyncClient:
         self = cls(endpoint, params)
         # Datagrams from any other source must be ignored (the socket is
         # deliberately unconnected at the OS level — see lspnet.udp).
-        self._peer = (socket.gethostbyname(host), port)
+        # Resolve via the loop: gethostbyname would block the event loop —
+        # and every other connection on it — for the resolver timeout.
+        infos = await asyncio.get_running_loop().getaddrinfo(
+            host, port, family=socket.AF_INET, type=socket.SOCK_DGRAM
+        )
+        self._peer = infos[0][4][:2]
         connect_wire = Message.connect()
         self._endpoint.send(connect_wire.marshal())
         epochs = 0

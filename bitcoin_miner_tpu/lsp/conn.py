@@ -109,6 +109,8 @@ class ConnCore:
         in-order delivery with reorder buffering (rules 4, 5 and the lsp5
         Size contract the reference never implemented, SURVEY §8.5)."""
         payload = msg.payload or b""
+        if msg.size < 0:
+            return  # nonsense Size (never produced by a real sender): drop
         if len(payload) < msg.size:
             return  # truncated in flight: drop silently, no ack
         if len(payload) > msg.size:

@@ -1,0 +1,50 @@
+"""The flagship "miner model": the chunked min-hash search step.
+
+This framework's analogue of a model forward pass (SURVEY §2.3): the input
+batch is a set of 10^k-aligned nonce chunks (message-word templates +
+lane bounds), the "forward" is the vectorised SHA-256 compression over all
+lanes, and the output is the reduced ``(min_h0, min_h1, argmin_lane)``.
+The training-step analogue is the sharded version of the same step with the
+collective min cascade across the device mesh (parallel/sweep.py).
+
+Used by ``__graft_entry__.py`` for the driver's single-chip compile check
+and multi-chip dry run.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ..ops.sweep import (
+    Chunk,
+    ChunkGroup,
+    _fill_templates,
+    _layout_cache,
+    make_kernel_body,
+)
+
+
+def forward_step_example(
+    data: bytes = b"cmu440", d: int = 6, k: int = 4, batch: int = 8
+) -> Tuple:
+    """Build ``(fn, example_args)`` for one representative shape class.
+
+    ``fn`` is the pure jittable single-device min-hash step; the example
+    args are real templates for nonces ``[10^(d-1), 10^(d-1) + batch*10^k)``
+    of ``Hash(data, nonce)``.
+    """
+    layout = _layout_cache(data, d)
+    low_pos = layout.digit_pos[layout.digit_count - k :]
+    fn = make_kernel_body(layout.n_tail_blocks, low_pos, k, batch)
+
+    span = 10**k
+    base0 = 10 ** (d - 1)
+    chunks = tuple(
+        Chunk(base=base0 + i * span, lo_off=0, hi_off=span) for i in range(batch)
+    )
+    group = ChunkGroup(d=d, k=k, chunks=chunks)
+    tail_const, bounds = _fill_templates(layout, group, chunks, batch)
+    midstate = np.array(layout.midstate, dtype=np.uint32)
+    return fn, (midstate, tail_const, bounds)

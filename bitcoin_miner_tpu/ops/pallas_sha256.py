@@ -36,7 +36,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .sha256 import DigitPos, compress
+from .sha256 import DigitPos, compress, compress_rolled
 
 U32_MAX = 0xFFFFFFFF
 I32_MAX = 0x7FFFFFFF
@@ -129,6 +129,12 @@ def make_pallas_minhash(
             i = t * tile + row * 128 + col  # lane index within this chunk
 
             state = tuple(midstate_ref[s] for s in range(8))
+            if interpret:
+                from .sha256 import K
+
+                # Stacked from inline scalars: pallas forbids closure-
+                # captured array constants.
+                k_table = jnp.stack([jnp.uint32(int(v)) for v in K])
             for blk in range(n_tail_blocks):
                 w = []
                 for widx in range(blk * 16, (blk + 1) * 16):
@@ -137,7 +143,14 @@ def make_pallas_minhash(
                         w.append(contrib_refs[word_to_cidx[widx]][...] | base)
                     else:
                         w.append(jnp.full((sub, 128), base, dtype=jnp.uint32))
-                state = compress(state, w)
+                # Mosaic wants the unrolled straight-line rounds (registers,
+                # software pipelining); interpret mode traces the kernel as
+                # plain XLA ops, where the unrolled DAG (x grid programs)
+                # sends XLA:CPU into minutes-long LLVM compiles — roll it.
+                if interpret:
+                    state = compress_rolled(state, w, k_table=k_table)
+                else:
+                    state = compress(state, w)
 
             valid = (i >= lo) & (i < hi)
             h0 = jnp.where(valid, state[0], jnp.uint32(U32_MAX))

@@ -97,6 +97,65 @@ def compress(state: Sequence, w: Sequence) -> Tuple:
     return tuple(x + y for x, y in zip(s, init))
 
 
+def compress_rolled(state: Sequence, w: Sequence, k_table=None) -> Tuple:
+    """One SHA-256 compression with the 64 rounds as ``lax.fori_loop``s.
+
+    Same contract as :func:`compress`, different compilation shape: the
+    unrolled straight-line DAG (~2.5k ops) sends XLA:CPU's LLVM backend into
+    minutes-long compiles, so the XLA-tier sweep kernel uses this rolled
+    form — a ~20-op loop body that compiles in seconds everywhere.  The cost
+    is materialising the 16-word schedule buffer at the broadcast lane shape
+    (the loop carry must be fixed-shape), so callers bound lanes-per-chunk
+    accordingly (ops/sweep.py caps the xla tier's ``max_k``).  Pallas keeps
+    the unrolled form: Mosaic compiles per-tile straight-line code fast and
+    the rounds stay in vector registers.
+    """
+    from jax import lax
+
+    shape = jnp.broadcast_shapes(
+        *(jnp.shape(x) for x in w), *(jnp.shape(s) for s in state)
+    )
+    # A pallas kernel body may not close over array constants; such callers
+    # pass their own k_table built from inline scalars (pallas_sha256.py).
+    k_arr = jnp.asarray(K) if k_table is None else k_table
+    wbuf = jnp.stack(
+        [jnp.broadcast_to(jnp.asarray(x, jnp.uint32), shape) for x in w]
+    )
+    st0 = tuple(
+        jnp.broadcast_to(jnp.asarray(s, jnp.uint32), shape) for s in state
+    )
+
+    def _round(t, st, wt):
+        a, b, c, d, e, f, g, h = st
+        s1e = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1e + ch + k_arr[t] + wt
+        s0a = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        return (t1 + s0a + maj, a, b, c, d + t1, e, f, g)
+
+    def _idx(buf, i):
+        return lax.dynamic_index_in_dim(buf, i, 0, keepdims=False)
+
+    def phase1(t, carry):  # rounds 0..15: message words straight from w
+        st, buf = carry
+        return _round(t, st, _idx(buf, t)), buf
+
+    def phase2(t, carry):  # rounds 16..63: rotating 16-slot schedule
+        st, buf = carry
+        w15 = _idx(buf, (t + 1) % 16)
+        w2 = _idx(buf, (t + 14) % 16)
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> jnp.uint32(3))
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> jnp.uint32(10))
+        wt = _idx(buf, t % 16) + s0 + _idx(buf, (t + 9) % 16) + s1
+        buf = lax.dynamic_update_index_in_dim(buf, wt, t % 16, 0)
+        return _round(t, st, wt), buf
+
+    st, wbuf = lax.fori_loop(0, 16, lambda t, c: phase1(t, c), (st0, wbuf))
+    st, _ = lax.fori_loop(16, 64, lambda t, c: phase2(t, c), (st, wbuf))
+    return tuple(x + y for x, y in zip(st, st0))
+
+
 # --------------------------------------------------------------------------
 # Pure-Python compression (host tier: midstate + oracle cross-checks)
 # --------------------------------------------------------------------------

@@ -23,15 +23,16 @@ Decomposition invariants:
 
 from __future__ import annotations
 
+import collections
 from dataclasses import dataclass
-from functools import lru_cache, partial
-from typing import Iterator, List, Optional, Sequence, Tuple
+from functools import lru_cache
+from typing import Deque, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .sha256 import DigitPos, MsgLayout, build_layout, compress
+from .sha256 import DigitPos, MsgLayout, build_layout, compress, compress_rolled
 
 U32_MAX = 0xFFFFFFFF
 I32_MAX = 0x7FFFFFFF
@@ -97,15 +98,30 @@ def decompose_range(lower: int, upper: int, max_k: int = 6) -> Iterator[ChunkGro
 # --------------------------------------------------------------------------
 
 
-@lru_cache(maxsize=256)
-def _make_kernel(n_tail_blocks: int, low_pos: Tuple[DigitPos, ...], k: int, batch: int):
-    """Compile a min-hash kernel for one (layout, k, batch) shape class.
+def make_kernel_body(
+    n_tail_blocks: int,
+    low_pos: Tuple[DigitPos, ...],
+    k: int,
+    batch: int,
+    rolled: Optional[bool] = None,
+):
+    """Build the pure (un-jitted) min-hash kernel body for one
+    (layout, k, batch) shape class.
 
-    Returned jitted fn: ``(midstate (8,), tail_const (B, nw), bounds (B, 2))
+    Returned fn: ``(midstate (8,), tail_const (B, nw), bounds (B, 2))
     -> (min_h0, min_h1, flat_idx)`` where flat_idx indexes the (B, 10^k)
-    lane grid row-major, or I32_MAX if every lane was masked out.
+    lane grid row-major, or I32_MAX if every lane was masked out.  Pure so
+    the multi-chip layer can re-trace it inside ``shard_map``
+    (bitcoin_miner_tpu.parallel.sweep).
+
+    ``rolled`` picks the compression form: the unrolled straight-line DAG
+    (best on TPU — fused, register-resident) vs the fori_loop form (XLA:CPU
+    chokes on the unrolled DAG's LLVM compile).  None = by platform.
     """
     n_lanes = 10**k
+    if rolled is None:
+        rolled = jax.default_backend() != "tpu"
+    comp = compress_rolled if rolled else compress
 
     def kernel(midstate, tail_const, bounds):
         i = jnp.arange(n_lanes, dtype=jnp.int32)
@@ -125,7 +141,7 @@ def _make_kernel(n_tail_blocks: int, low_pos: Tuple[DigitPos, ...], k: int, batc
                     w.append(col | contrib[widx][None, :])  # (B, N)
                 else:
                     w.append(col)
-            state = compress(state, w)
+            state = comp(state, w)
         h0 = jnp.broadcast_to(state[0], (batch, n_lanes))
         h1 = jnp.broadcast_to(state[1], (batch, n_lanes))
 
@@ -146,7 +162,19 @@ def _make_kernel(n_tail_blocks: int, low_pos: Tuple[DigitPos, ...], k: int, batc
         flat_idx = jnp.min(jnp.where(e1, flat, jnp.int32(I32_MAX)))
         return min_h0, min_h1, flat_idx
 
-    return jax.jit(kernel)
+    return kernel
+
+
+@lru_cache(maxsize=256)
+def _make_kernel(
+    n_tail_blocks: int,
+    low_pos: Tuple[DigitPos, ...],
+    k: int,
+    batch: int,
+    rolled: bool,
+):
+    """Jitted single-device wrapper over :func:`make_kernel_body`."""
+    return jax.jit(make_kernel_body(n_tail_blocks, low_pos, k, batch, rolled))
 
 
 @lru_cache(maxsize=256)
@@ -192,12 +220,70 @@ def _default_backend() -> str:
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
+def auto_tune(
+    backend: Optional[str], batch: Optional[int], max_k: Optional[int]
+) -> Tuple[str, int, int]:
+    """Resolve the (backend, rows-per-dispatch, max_k) defaults shared by the
+    single-device and sharded sweep drivers.  max_k=5 bounds the xla tier's
+    compress_rolled schedule buffer ((16, B, 10^k) u32) to ~50 MB at B=8."""
+    if backend is None:
+        backend = _default_backend()
+    if batch is None:
+        batch = 1024 if backend == "pallas" else 8
+    if max_k is None:
+        max_k = 6 if backend == "pallas" else 5
+    return backend, batch, max_k
+
+
+def run_sweep_dispatches(
+    data: str,
+    lower: int,
+    upper: int,
+    max_k: int,
+    batch: int,
+    get_kernel,
+    run_kernel,
+    consume,
+    max_inflight: int = 32,
+) -> int:
+    """The decompose → template-fill → dispatch skeleton shared by the
+    single-device (below) and sharded (parallel/sweep.py) drivers.
+
+    ``get_kernel(layout, group)`` builds/caches the kernel for a shape class;
+    ``run_kernel(kern, midstate, tail_const, bounds)`` queues one dispatch
+    and returns its (not-yet-fetched) output handle;
+    ``consume(out, chunk_bases, 10^k)`` fetches and folds one result.
+    At most ``max_inflight`` dispatches stay queued — enough to keep the
+    device busy while the host fills the next templates, while bounding host
+    state for huge ranges (a 10^12-nonce sweep is ~10^6 dispatches on the
+    xla tier).  Returns the number of lanes swept.
+    """
+    data_bytes = data.encode("utf-8")
+    pending: Deque[Tuple] = collections.deque()
+    lanes = 0
+    for group in decompose_range(lower, upper, max_k=max_k):
+        layout = _layout_cache(data_bytes, group.d)
+        kern = get_kernel(layout, group)
+        midstate = np.array(layout.midstate, dtype=np.uint32)
+        for s in range(0, len(group.chunks), batch):
+            rows = group.chunks[s : s + batch]
+            tail_const, bounds = _fill_templates(layout, group, rows, batch)
+            out = run_kernel(kern, midstate, tail_const, bounds)
+            pending.append((out, [c.base for c in rows], 10**group.k))
+            lanes += sum(c.hi_off - c.lo_off for c in rows)
+            if len(pending) > max_inflight:
+                consume(*pending.popleft())
+    while pending:
+        consume(*pending.popleft())
+    return lanes
+
+
 def sweep_min_hash(
     data: str,
     lower: int,
     upper: int,
     *,
-    max_k: int = 6,
+    max_k: Optional[int] = None,
     batch: Optional[int] = None,
     backend: Optional[str] = None,
     interpret: bool = False,
@@ -215,48 +301,42 @@ def sweep_min_hash(
     TPUs is O(100 ms), so the pallas tier defaults to a large super-batch
     (~1e9 nonces/dispatch); padding rows are skipped in-kernel.
     """
-    if backend is None:
-        backend = _default_backend()
-    if batch is None:
-        batch = 1024 if backend == "pallas" else 8
-    data_bytes = data.encode("utf-8")
-    pending: List[Tuple] = []
-    lanes = 0
-    for group in decompose_range(lower, upper, max_k=max_k):
-        layout = _layout_cache(data_bytes, group.d)
+    backend, batch, max_k = auto_tune(backend, batch, max_k)
+    rolled = jax.default_backend() != "tpu"
+
+    def get_kernel(layout, group):
         low_pos = layout.digit_pos[layout.digit_count - group.k :]
         if backend == "pallas":
             from .pallas_sha256 import make_pallas_minhash
 
-            kern = make_pallas_minhash(
+            return make_pallas_minhash(
                 layout.n_tail_blocks, low_pos, group.k, batch, interpret=interpret
             )
-        else:
-            kern = _make_kernel(layout.n_tail_blocks, low_pos, group.k, batch)
-        midstate = jnp.asarray(np.array(layout.midstate, dtype=np.uint32))
-        for s in range(0, len(group.chunks), batch):
-            rows = group.chunks[s : s + batch]
-            tail_const, bounds = _fill_templates(layout, group, rows, batch)
-            if backend == "pallas":
-                tailcb = np.concatenate(
-                    [tail_const, bounds.astype(np.uint32)], axis=1
-                )
-                out = kern(midstate, jnp.asarray(tailcb))
-            else:
-                out = kern(midstate, jnp.asarray(tail_const), jnp.asarray(bounds))
-            bases = [c.base for c in rows]
-            pending.append((out, bases, 10**group.k))
-            lanes += sum(c.hi_off - c.lo_off for c in rows)
+        return _make_kernel(layout.n_tail_blocks, low_pos, group.k, batch, rolled)
 
-    best: Optional[Tuple[int, int]] = None  # (hash, nonce)
-    for (h0, h1, flat_idx), bases, n_lanes in pending:
+    def run_kernel(kern, midstate, tail_const, bounds):
+        if backend == "pallas":
+            tailcb = np.concatenate([tail_const, bounds.astype(np.uint32)], axis=1)
+            return kern(jnp.asarray(midstate), jnp.asarray(tailcb))
+        return kern(
+            jnp.asarray(midstate), jnp.asarray(tail_const), jnp.asarray(bounds)
+        )
+
+    best: List[Tuple[int, int]] = []  # [(hash, nonce)] — current minimum
+
+    def consume(out, bases, n_lanes):
+        h0, h1, flat_idx = out
         fi = int(flat_idx)
         if fi == I32_MAX:
-            continue  # fully-masked call (shouldn't happen with real chunks)
+            return  # fully-masked call (shouldn't happen with real chunks)
         h = (int(h0) << 32) | int(h1)
-        nonce = bases[fi // n_lanes] + fi % n_lanes
-        if best is None or (h, nonce) < best:
-            best = (h, nonce)
-    if best is None:
+        cand = (h, bases[fi // n_lanes] + fi % n_lanes)
+        if not best or cand < best[0]:
+            best[:] = [cand]
+
+    lanes = run_sweep_dispatches(
+        data, lower, upper, max_k, batch, get_kernel, run_kernel, consume
+    )
+    if not best:
         raise RuntimeError("sweep produced no candidates")
-    return SweepResult(hash=best[0], nonce=best[1], lanes_swept=lanes)
+    return SweepResult(hash=best[0][0], nonce=best[0][1], lanes_swept=lanes)

@@ -1,0 +1,6 @@
+"""Device-mesh parallelism: shard_map sweep + collective min reduction."""
+
+from .mesh import MINER_AXIS, default_mesh
+from .sweep import sweep_min_hash_sharded
+
+__all__ = ["MINER_AXIS", "default_mesh", "sweep_min_hash_sharded"]

@@ -1,0 +1,42 @@
+"""Device-mesh helpers for the multi-chip sweep.
+
+The reference scales only by process-level data parallelism (the server's
+range split, SURVEY §2.3); this layer adds the intra-miner axis the TPU
+design needs: a 1-D ``jax.sharding.Mesh`` over the local chips, with the
+min-hash reduction riding ICI via XLA collectives (see parallel/sweep.py).
+A miner process therefore presents *one* worker to the scheduler no matter
+how many chips it drives — preserving the reference's plugin boundary
+(BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+MINER_AXIS = "miners"
+
+
+def default_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = MINER_AXIS,
+) -> Mesh:
+    """A 1-D mesh over the local devices.
+
+    ``n_devices=None`` takes every visible device.  The nonce sweep is
+    embarrassingly parallel, so one axis suffices; richer meshes (e.g.
+    (hosts, chips)) would only matter for a DCN-spanning jit, which this
+    framework intentionally replaces with LSP process parallelism.
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if n_devices > len(devices):
+                raise ValueError(
+                    f"requested {n_devices} devices, have {len(devices)}"
+                )
+            devices = devices[:n_devices]
+    return Mesh(list(devices), (axis_name,))

@@ -1,0 +1,181 @@
+"""Multi-chip nonce sweep: shard_map over a device mesh + collective min.
+
+This is the ICI plane of the comms design (SURVEY §2.3/§5): chunk batches are
+sharded across the mesh's ``miners`` axis, each device runs the single-chip
+min-hash kernel on its shard, and a psum-style collective cascade reduces the
+lexicographic ``(h0, h1, nonce-order)`` minimum across chips — the TPU-native
+analogue of the reference's server-side min-fold over miner Results
+(``bitcoin/message.go:38-44``), and the ``lax.pmin`` reduction named in the
+BASELINE north star.
+
+Tie-break: chunk rows are sharded *contiguously* in ascending-nonce order, so
+``(device, flat_idx)`` lexicographic order equals nonce order and the
+collective cascade preserves lowest-nonce-wins.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.sha256 import DigitPos
+from ..ops.sweep import (
+    I32_MAX,
+    U32_MAX,
+    SweepResult,
+    auto_tune,
+    make_kernel_body,
+    run_sweep_dispatches,
+)
+from .mesh import MINER_AXIS, default_mesh
+
+
+def _collective_min(h0, h1, flat, axis: str):
+    """Reduce per-device (h0, h1, flat_idx) scalars to the replicated global
+    lexicographic min, lowest-(device, flat) — i.e. lowest-nonce — ties.
+
+    Three chained ``lax.pmin``s: min h0, then min h1 among h0-winners, then
+    min (device, flat) among (h0, h1)-winners.  All collectives ride the mesh
+    axis (ICI on real hardware).
+    """
+    g_h0 = lax.pmin(h0, axis)
+    h1m = jnp.where(h0 == g_h0, h1, jnp.uint32(U32_MAX))
+    g_h1 = lax.pmin(h1m, axis)
+    mine = (h0 == g_h0) & (h1m == g_h1) & (flat != jnp.int32(I32_MAX))
+    dev = lax.axis_index(axis).astype(jnp.int32)
+    g_dev = lax.pmin(jnp.where(mine, dev, jnp.int32(I32_MAX)), axis)
+    g_flat = lax.pmin(
+        jnp.where(mine & (dev == g_dev), flat, jnp.int32(I32_MAX)), axis
+    )
+    return g_h0, g_h1, g_dev, g_flat
+
+
+@lru_cache(maxsize=256)
+def _make_sharded_kernel(
+    n_tail_blocks: int,
+    low_pos: Tuple[DigitPos, ...],
+    k: int,
+    per_dev_batch: int,
+    mesh: Mesh,
+    axis_name: str,
+    backend: str,
+    interpret: bool,
+    rolled: bool,
+):
+    """Compile the sharded kernel for one (layout, k, batch) shape class.
+
+    Returned jitted fn: ``(midstate (8,), tail_const (B, nw), bounds (B, 2))
+    -> (g_h0, g_h1, g_dev, g_flat)`` replicated scalars, where
+    ``B = n_devices * per_dev_batch`` and rows are sharded contiguously
+    along ``axis_name``.
+    """
+    if backend == "pallas":
+        from ..ops.pallas_sha256 import make_pallas_minhash
+
+        pallas_fn = make_pallas_minhash(
+            n_tail_blocks, low_pos, k, per_dev_batch, interpret=interpret
+        )
+
+        def local(midstate, tail_const, bounds):
+            tailcb = jnp.concatenate(
+                [tail_const, bounds.astype(jnp.uint32)], axis=1
+            )
+            return pallas_fn(midstate, tailcb)
+
+    else:
+        local = make_kernel_body(n_tail_blocks, low_pos, k, per_dev_batch, rolled)
+
+    def shard_fn(midstate, tail_const, bounds):
+        h0, h1, flat = local(midstate, tail_const, bounds)
+        return _collective_min(h0, h1, flat, axis_name)
+
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(axis_name, None), P(axis_name, None)),
+        out_specs=(P(), P(), P(), P()),
+        # pallas_call's out_shape carries no varying-mesh-axes annotation, so
+        # the vma checker can't see through it; the collective cascade above
+        # makes every output genuinely replicated.
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def sweep_min_hash_sharded(
+    data: str,
+    lower: int,
+    upper: int,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = MINER_AXIS,
+    max_k: Optional[int] = None,
+    batch_per_device: Optional[int] = None,
+    backend: Optional[str] = None,
+    interpret: bool = False,
+) -> SweepResult:
+    """Multi-chip ``(min Hash(data, n), argmin n)`` over inclusive
+    ``[lower, upper]``; bit-exact vs the hashlib oracle, lowest-nonce ties.
+
+    Chunk rows pad up to ``n_devices * batch_per_device`` per dispatch
+    (padded rows have empty lane bounds and are masked in-kernel).  Results
+    are fetched lazily after all dispatches are queued so the device
+    pipeline stays full.
+    """
+    if mesh is None:
+        mesh = default_mesh(axis_name=axis_name)
+    n_dev = mesh.devices.size
+    if backend is None and mesh.devices.flat[0].platform != "tpu":
+        backend = "xla"
+    backend, batch_per_device, max_k = auto_tune(backend, batch_per_device, max_k)
+    rolled = mesh.devices.flat[0].platform != "tpu"
+    batch = n_dev * batch_per_device
+
+    row_sharding = NamedSharding(mesh, P(axis_name, None))
+    rep_sharding = NamedSharding(mesh, P())
+
+    def get_kernel(layout, group):
+        low_pos = layout.digit_pos[layout.digit_count - group.k :]
+        return _make_sharded_kernel(
+            layout.n_tail_blocks,
+            low_pos,
+            group.k,
+            batch_per_device,
+            mesh,
+            axis_name,
+            backend,
+            interpret,
+            rolled,
+        )
+
+    def run_kernel(kern, midstate, tail_const, bounds):
+        return kern(
+            jax.device_put(midstate, rep_sharding),
+            jax.device_put(tail_const, row_sharding),
+            jax.device_put(bounds, row_sharding),
+        )
+
+    best: list = []
+
+    def consume(out, bases, n_lanes):
+        h0, h1, dev, flat = out
+        fi = int(flat)
+        if fi == I32_MAX:
+            return
+        row = int(dev) * batch_per_device + fi // n_lanes
+        h = (int(h0) << 32) | int(h1)
+        cand = (h, bases[row] + fi % n_lanes)
+        if not best or cand < best[0]:
+            best[:] = [cand]
+
+    lanes = run_sweep_dispatches(
+        data, lower, upper, max_k, batch, get_kernel, run_kernel, consume
+    )
+    if not best:
+        raise RuntimeError("sharded sweep produced no candidates")
+    return SweepResult(hash=best[0][0], nonce=best[0][1], lanes_swept=lanes)

@@ -1,16 +1,29 @@
 """Test configuration.
 
-Force JAX onto a virtual 8-device CPU platform *before* jax is imported
-anywhere, so multi-chip sharding paths (Mesh / shard_map / collectives) are
-exercised without TPU hardware.  bench.py and the driver's graft entry run
-outside pytest and therefore see the real TPU.
+Force JAX onto a virtual 8-device CPU platform so multi-chip sharding paths
+(Mesh / shard_map / collectives) are exercised without TPU hardware.
+
+Note: this environment's sitecustomize imports jax at interpreter boot with
+``JAX_PLATFORMS`` already set, so env vars alone are too late — the platform
+override must go through ``jax.config`` (backends initialize lazily, so this
+still wins as long as no computation has run).  ``XLA_FLAGS`` is read at
+backend init and can still be set here.  bench.py and the driver's graft
+entry run outside pytest and therefore see the real TPU.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# XLA:CPU compiles of the sweep kernels take seconds each; cache them across
+# pytest runs so only the first invocation pays.
+jax.config.update("jax_compilation_cache_dir", "/tmp/bitcoin_miner_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -18,6 +18,7 @@ import time
 import pytest
 
 from bitcoin_miner_tpu import lsp, lspnet
+from bitcoin_miner_tpu.lsp.conn import ConnCore
 from lsp_harness import spawn
 
 EPOCH_MS = 100
@@ -105,3 +106,16 @@ def test_short_single_byte_payload_edge():
     assert payload == b"x"
     client.close()
     server.close()
+
+
+def test_negative_size_dropped_not_truncated():
+    """A crafted Data with Size < 0 must be dropped entirely — a Python
+    negative-index truncation (payload[:Size]) would otherwise deliver a
+    mangled prefix AND consume the seq, poisoning the real retransmission."""
+    sent, delivered = [], []
+    core = ConnCore(1, PARAMS, sent.append, delivered.append)
+    core.on_data(lsp.Message.data(1, 1, -3, b"hello"))
+    assert delivered == [] and sent == []  # no delivery, no ack
+    # The genuine seq-1 message must still go through afterwards.
+    core.on_data(lsp.Message.data(1, 1, 5, b"hello"))
+    assert delivered == [b"hello"]

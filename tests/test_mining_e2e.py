@@ -1,0 +1,213 @@
+"""End-to-end mining integration tests (B8, BASELINE configs 1/3/5).
+
+Real loopback UDP through the full LSP stack: a server thread running the
+scheduler loop, miner threads on the CPU-oracle backend (byte-identical to
+the Go reference's hot loop), and client threads using the frozen
+request/response path.  Mirrors the reference test style (SURVEY §4):
+everything in one process, epoch-denominated timeouts, the lspnet seam for
+fault injection.
+"""
+
+import threading
+import time
+
+import pytest
+
+from bitcoin_miner_tpu import lsp, lspnet
+from bitcoin_miner_tpu.apps import client as client_mod
+from bitcoin_miner_tpu.apps import miner as miner_mod
+from bitcoin_miner_tpu.apps import server as server_mod
+from bitcoin_miner_tpu.apps.scheduler import Scheduler
+from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+from bitcoin_miner_tpu.bitcoin.message import Message
+
+
+PARAMS = lsp.Params(epoch_limit=5, epoch_millis=200, window_size=5)
+
+
+@pytest.fixture(autouse=True)
+def _clean_network():
+    lspnet.reset_faults()
+    yield
+    lspnet.reset_faults()
+
+
+class MiningSystem:
+    """In-process cluster: scheduler server + N miner threads."""
+
+    def __init__(self, n_miners: int = 2, min_chunk: int = 500):
+        self.server = lsp.Server(0, PARAMS)
+        self.port = self.server.port
+        self.scheduler = Scheduler(min_chunk=min_chunk)
+        self.server_thread = threading.Thread(
+            target=server_mod.serve, args=(self.server, self.scheduler), daemon=True
+        )
+        self.server_thread.start()
+        self.miner_clients = []
+        self.miner_threads = []
+        for _ in range(n_miners):
+            self.add_miner()
+
+    def add_miner(self, search=None):
+        c = lsp.Client("127.0.0.1", self.port, PARAMS)
+        t = threading.Thread(
+            target=miner_mod.run_miner,
+            args=(c, search or miner_mod.make_search("cpu")),
+            daemon=True,
+        )
+        t.start()
+        self.miner_clients.append(c)
+        self.miner_threads.append(t)
+        return c
+
+    def request(self, data: str, max_nonce: int):
+        c = lsp.Client("127.0.0.1", self.port, PARAMS)
+        try:
+            return client_mod.request_once(c, data, max_nonce)
+        finally:
+            c.close()
+
+    def close(self):
+        self.server.close()
+
+
+def test_single_miner_correct_result():
+    sys_ = MiningSystem(n_miners=1)
+    try:
+        res = sys_.request("cmu440", 4999)
+        assert res == min_hash_range("cmu440", 0, 4999)
+    finally:
+        sys_.close()
+
+
+def test_multi_miner_range_split_correct():
+    sys_ = MiningSystem(n_miners=4, min_chunk=300)
+    try:
+        res = sys_.request("distributed", 7999)
+        assert res == min_hash_range("distributed", 0, 7999)
+    finally:
+        sys_.close()
+
+
+def test_concurrent_clients():
+    sys_ = MiningSystem(n_miners=3, min_chunk=400)
+    results = {}
+
+    def one(job):
+        data, mx = job
+        results[job] = sys_.request(data, mx)
+
+    jobs = [("alpha", 3000), ("beta", 4000), ("gamma", 2500)]
+    try:
+        threads = [threading.Thread(target=one, args=(j,)) for j in jobs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "client timed out"
+        for data, mx in jobs:
+            assert results[(data, mx)] == min_hash_range(data, 0, mx)
+    finally:
+        sys_.close()
+
+
+def test_heterogeneous_backends():
+    """A fast+slow fleet (10x rate skew) still min-folds correctly — the
+    BASELINE config-3 shape (CPU + TPU mix) with the skew simulated."""
+
+    def slow_search(data, lower, upper):
+        time.sleep(0.05)
+        return min_hash_range(data, lower, upper)
+
+    sys_ = MiningSystem(n_miners=0, min_chunk=500)
+    try:
+        sys_.add_miner(miner_mod.make_search("cpu"))
+        sys_.add_miner(slow_search)
+        res = sys_.request("hetero", 6000)
+        assert res == min_hash_range("hetero", 0, 6000)
+    finally:
+        sys_.close()
+
+
+def test_miner_killed_mid_job_range_reassigned():
+    """BASELINE config 5: kill a miner mid-job; the server must reassign its
+    outstanding chunk and the final result must be unchanged."""
+    block = threading.Event()
+    killed = threading.Event()
+
+    def stalling_search(data, lower, upper):
+        if not killed.is_set():
+            killed.set()
+            block.wait(timeout=30)  # hold the chunk until we are killed
+        return min_hash_range(data, lower, upper)
+
+    sys_ = MiningSystem(n_miners=0, min_chunk=500)
+    try:
+        victim = sys_.add_miner(stalling_search)
+        sys_.add_miner()
+
+        out = {}
+
+        def run_client():
+            out["res"] = sys_.request("faulty", 4000)
+
+        t = threading.Thread(target=run_client, daemon=True)
+        t.start()
+        assert killed.wait(timeout=30), "victim never got a chunk"
+        victim.close()  # miner process dies; epochs declare it lost
+        t.join(timeout=60)
+        assert not t.is_alive(), "client never got a result"
+        assert out["res"] == min_hash_range("faulty", 0, 4000)
+    finally:
+        block.set()
+        sys_.close()
+
+
+def test_client_death_cancels_job_and_server_survives():
+    sys_ = MiningSystem(n_miners=1, min_chunk=200)
+    try:
+        c = lsp.Client("127.0.0.1", sys_.port, PARAMS)
+        c.write(Message.request("doomed", 0, 10**7).marshal())
+        time.sleep(0.3)  # let the job get scheduled
+        c.close()
+        deadline = time.time() + PARAMS.epoch_limit * PARAMS.epoch_seconds + 5
+        while time.time() < deadline and sys_.scheduler.jobs:
+            time.sleep(0.1)
+        assert sys_.scheduler.jobs == {}, "job not cancelled after client death"
+        # Server still serves new work afterwards.
+        res = sys_.request("alive", 1500)
+        assert res == min_hash_range("alive", 0, 1500)
+    finally:
+        sys_.close()
+
+
+def test_mining_under_packet_loss():
+    """Request/Result survive 20% write drop both ways (lsp retransmits)."""
+    sys_ = MiningSystem(n_miners=2, min_chunk=500)
+    try:
+        lspnet.set_write_drop_percent(20)
+        res = sys_.request("lossy", 3000)
+        assert res == min_hash_range("lossy", 0, 3000)
+    finally:
+        lspnet.reset_faults()
+        sys_.close()
+
+
+def test_client_disconnected_output():
+    """Frozen stdout contract: server dies -> client prints Disconnected."""
+    import io
+
+    sys_ = MiningSystem(n_miners=0)
+    port = sys_.port
+    out = io.StringIO()
+
+    def run():
+        client_mod.main(["client", f"127.0.0.1:{port}", "x", "100000"], out=out)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.5)  # request reaches the (miner-less) scheduler
+    sys_.close()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert out.getvalue() == "Disconnected\n"

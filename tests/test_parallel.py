@@ -1,0 +1,56 @@
+"""Multi-chip sharding: shard_map sweep + collective min on the virtual
+8-device CPU mesh (SURVEY §2.3 — the ICI plane).
+
+The Pallas tier can't run sharded here (Mosaic needs a TPU; interpret mode
+deadlocks XLA:CPU's in-process collective rendezvous), so the sharded path
+is validated with the xla tier — identical sharding structure, identical
+collective cascade.  The driver's dryrun_multichip uses the same path.
+"""
+
+import jax
+
+from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+from bitcoin_miner_tpu.parallel import default_mesh, sweep_min_hash_sharded
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+    mesh = default_mesh()
+    assert mesh.devices.size == 8
+
+
+def test_sharded_matches_oracle_single_group():
+    # One digit group (d=4, k=2) -> one kernel compile; 13 chunks pad across
+    # 8 devices x batch 2, exercising padded-row masking.
+    r = sweep_min_hash_sharded(
+        "cmu440", 1000, 2234, backend="xla", max_k=2, batch_per_device=2
+    )
+    assert (r.hash, r.nonce) == min_hash_range("cmu440", 1000, 2234)
+    assert r.lanes_swept == 2234 - 1000 + 1
+
+
+def test_sharded_matches_oracle_digit_boundary():
+    r = sweep_min_hash_sharded(
+        "x", 95, 305, backend="xla", max_k=1, batch_per_device=2
+    )
+    assert (r.hash, r.nonce) == min_hash_range("x", 95, 305)
+
+
+def test_sharded_subset_mesh():
+    mesh = default_mesh(2)
+    r = sweep_min_hash_sharded(
+        "cmu440", 1000, 1999, mesh=mesh, backend="xla", max_k=2, batch_per_device=2
+    )
+    assert (r.hash, r.nonce) == min_hash_range("cmu440", 1000, 1999)
+
+
+def test_sharded_matches_single_device_tier():
+    from bitcoin_miner_tpu.ops.sweep import sweep_min_hash
+
+    # Same data/digit-count as the single-group test -> reuses its compile.
+    data, lo, hi = "cmu440", 1100, 3333
+    rs = sweep_min_hash_sharded(
+        data, lo, hi, backend="xla", max_k=2, batch_per_device=2
+    )
+    r1 = sweep_min_hash(data, lo, hi, backend="xla", max_k=2)
+    assert (rs.hash, rs.nonce) == (r1.hash, r1.nonce)

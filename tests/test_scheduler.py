@@ -1,0 +1,168 @@
+"""Unit tests of the pure Scheduler logic (no sockets, no JAX).
+
+The reference has no scheduler tests (its server is a stub); these pin the
+behavior SURVEY §3.6 reconstructs from the frozen contracts: join/request/
+result folding, adaptive chunking, dead-miner reassignment, dead-client
+cancellation, fairness.
+"""
+
+from bitcoin_miner_tpu.apps.scheduler import Scheduler
+from bitcoin_miner_tpu.bitcoin.message import MsgType
+
+
+def drain(job_actions):
+    return {cid: msg for cid, msg in job_actions}
+
+
+class TestBasicFlow:
+    def test_join_then_request_assigns(self):
+        s = Scheduler(min_chunk=100)
+        assert s.miner_joined(1) == []
+        actions = s.client_request(10, "data", 0, 99)
+        assert len(actions) == 1
+        cid, msg = actions[0]
+        assert cid == 1
+        assert msg.type == MsgType.REQUEST
+        assert (msg.lower, msg.upper) == (0, 99)
+
+    def test_request_then_join_assigns(self):
+        s = Scheduler(min_chunk=100)
+        assert s.client_request(10, "data", 0, 99) == []
+        actions = s.miner_joined(1)
+        assert len(actions) == 1
+        assert actions[0][0] == 1
+
+    def test_result_completes_job(self):
+        s = Scheduler(min_chunk=1000)
+        s.miner_joined(1)
+        s.client_request(10, "data", 0, 99)
+        actions = s.result(1, hash_=555, nonce=42)
+        assert actions[0] == (10, actions[0][1])
+        msg = actions[0][1]
+        assert msg.type == MsgType.RESULT
+        assert (msg.hash, msg.nonce) == (555, 42)
+        assert s.jobs == {}
+        assert s.miners[1].job is None  # miner idle again
+
+    def test_range_split_across_miners_min_folds(self):
+        s = Scheduler(min_chunk=50)
+        for m in (1, 2):
+            s.miner_joined(m)
+        actions = s.client_request(10, "data", 0, 99)
+        assert len(actions) == 2
+        ranges = sorted((m.lower, m.upper) for _, m in actions)
+        assert ranges == [(0, 49), (50, 99)]
+        assert s.result(1, hash_=900, nonce=7) == []  # half done: no reply yet
+        final = s.result(2, hash_=300, nonce=61)
+        # min-fold picks the smaller hash
+        assert final[0][1].hash == 300 and final[0][1].nonce == 61
+
+    def test_tie_break_lowest_nonce(self):
+        s = Scheduler(min_chunk=50)
+        s.miner_joined(1)
+        s.miner_joined(2)
+        s.client_request(10, "d", 0, 99)
+        s.result(2, hash_=100, nonce=80)
+        final = s.result(1, hash_=100, nonce=3)
+        assert final[0][1].nonce == 3
+
+    def test_empty_range_answers_immediately(self):
+        s = Scheduler()
+        actions = s.client_request(10, "d", 5, 4)
+        assert actions[0][0] == 10
+        assert actions[0][1].type == MsgType.RESULT
+
+
+class TestFaults:
+    def test_dead_miner_chunk_reassigned(self):
+        s = Scheduler(min_chunk=1000)
+        s.miner_joined(1)
+        s.client_request(10, "d", 0, 499)
+        actions = s.lost(1)  # miner dies mid-chunk
+        assert actions == []  # nobody to reassign to yet
+        actions = s.miner_joined(2)  # replacement arrives
+        assert len(actions) == 1
+        assert (actions[0][1].lower, actions[0][1].upper) == (0, 499)
+
+    def test_dead_miner_with_idle_peer_reassigns_immediately(self):
+        s = Scheduler(min_chunk=1000)
+        s.miner_joined(1)
+        s.miner_joined(2)
+        s.client_request(10, "d", 0, 499)  # one chunk -> one miner busy
+        busy = next(m for m in s.miners.values() if m.job is not None).conn_id
+        actions = s.lost(busy)
+        assert len(actions) == 1  # idle peer picks it straight up
+        assert (actions[0][1].lower, actions[0][1].upper) == (0, 499)
+
+    def test_dead_client_drops_job_and_result_ignored(self):
+        s = Scheduler(min_chunk=1000)
+        s.miner_joined(1)
+        s.client_request(10, "d", 0, 499)
+        assert s.lost(10) == []  # client dies: job cancelled silently
+        assert s.jobs == {}
+        actions = s.result(1, hash_=5, nonce=5)  # stale result arrives
+        assert actions == []  # ignored, miner back to idle
+        assert s.miners[1].job is None
+
+    def test_miner_death_preserves_low_nonce_order(self):
+        s = Scheduler(min_chunk=100, max_chunk=100)
+        s.miner_joined(1)
+        s.client_request(10, "d", 0, 299)  # miner 1 gets [0,99]
+        s.lost(1)
+        actions = s.miner_joined(2)  # must get [0,99] back first, not [100,199]
+        assert (actions[0][1].lower, actions[0][1].upper) == (0, 99)
+
+
+class TestAdaptiveChunking:
+    def test_fast_miner_gets_bigger_chunks(self):
+        s = Scheduler(min_chunk=100, max_chunk=10**9, target_chunk_seconds=1.0)
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "d", 0, 10**9, now=0.0)
+        # first chunk is min_chunk (rate unknown)
+        first = s.miners[1].interval
+        assert first == (0, 99)
+        # completes 100 nonces in 1 ms -> rate 1e5/s -> next chunk ~1e5
+        actions = s.result(1, hash_=7, nonce=0, now=0.001)
+        nxt = actions[0][1]
+        size = nxt.upper - nxt.lower + 1
+        assert 50_000 <= size <= 200_000
+
+    def test_chunk_capped_at_max(self):
+        s = Scheduler(min_chunk=10, max_chunk=1000, target_chunk_seconds=1.0)
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "d", 0, 10**9, now=0.0)
+        actions = s.result(1, hash_=7, nonce=0, now=1e-9)  # absurd rate
+        nxt = actions[0][1]
+        assert nxt.upper - nxt.lower + 1 == 1000
+
+
+class TestFairness:
+    def test_round_robin_across_jobs(self):
+        s = Scheduler(min_chunk=10, max_chunk=10)
+        s.client_request(10, "a", 0, 99)
+        s.client_request(11, "b", 0, 99)
+        served = []
+        for m in range(1, 5):
+            for cid, msg in s.miner_joined(m):
+                served.append(msg.data)
+        assert served.count("a") == 2 and served.count("b") == 2
+
+    def test_duplicate_join_ignored(self):
+        s = Scheduler()
+        s.miner_joined(1)
+        assert s.miner_joined(1) == []
+        assert len(s.miners) == 1
+
+    def test_second_request_on_same_conn_ignored(self):
+        s = Scheduler(min_chunk=10**6)
+        s.miner_joined(1)
+        s.client_request(10, "a", 0, 9)
+        assert s.client_request(10, "b", 0, 9) == []
+
+    def test_stats(self):
+        s = Scheduler(min_chunk=10, max_chunk=10)
+        s.miner_joined(1)
+        s.client_request(10, "a", 0, 99)
+        st = s.stats()
+        assert st["miners"] == 1 and st["idle_miners"] == 0
+        assert st["jobs"] == 1 and st["outstanding_chunks"] == 1

@@ -82,6 +82,23 @@ class TestBitcoinMessage:
         assert str(bitcoin.Message.request("d", 1, 2)) == "[Request d 1 2]"
         assert str(bitcoin.Message.result(10, 20)) == "[Result 10 20]"
 
+    def test_unmarshal_rejects_invalid_u64(self):
+        # Go json.Unmarshal errors on these for uint64 struct fields; a
+        # poison Request must never reach the scheduler (it would crash the
+        # miner assigned to it).
+        base = '{"Type":1,"Data":"x","Lower":%s,"Upper":10,"Hash":0,"Nonce":0}'
+        for bad in ("-5", "1.7", '"12"', "true", str(1 << 64)):
+            assert bitcoin.Message.unmarshal((base % bad).encode()) is None, bad
+        assert bitcoin.Message.unmarshal((base % "0").encode()) is not None
+
+    def test_unmarshal_rejects_non_string_data(self):
+        raw = b'{"Type":1,"Data":["x"],"Lower":0,"Upper":9,"Hash":0,"Nonce":0}'
+        assert bitcoin.Message.unmarshal(raw) is None
+
+    def test_unmarshal_rejects_non_int_type(self):
+        raw = b'{"Type":1.0,"Data":"x","Lower":0,"Upper":9,"Hash":0,"Nonce":0}'
+        assert bitcoin.Message.unmarshal(raw) is None
+
 
 class TestParams:
     def test_defaults(self):
