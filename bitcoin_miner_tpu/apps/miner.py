@@ -6,8 +6,9 @@ implemented with the hash search running on one of three backends:
 
 - ``pallas``  — the VMEM-resident TPU kernel (default on TPU)
 - ``xla``     — fused jnp tier (default elsewhere; also runs on CPU/GPU)
-- ``cpu``     — scalar hashlib loop, byte-identical to the Go reference
-  miner's hot loop; exists so heterogeneous fleets (Go-like CPU miners +
+- ``cpu``     — single-process CPU loop, bit-identical to the Go reference
+  miner's hot loop; compiled C++ w/ SHA-NI when available (native/),
+  hashlib otherwise.  Exists so heterogeneous fleets (Go-like CPU miners +
   TPU miners) exercise the same scheduler path (BASELINE.json config 3)
 
 ``--devices N`` spans the sweep over an N-chip mesh via shard_map +
@@ -24,6 +25,7 @@ from typing import Callable, Optional, Tuple
 from .. import lsp
 from ..bitcoin.hash import min_hash_range
 from ..bitcoin.message import Message, MsgType
+from ..utils.metrics import METRICS
 
 SearchFn = Callable[[str, int, int], Tuple[int, int]]  # -> (hash, nonce)
 
@@ -34,8 +36,14 @@ def make_search(backend: str = "auto", devices: Optional[int] = None) -> SearchF
         if devices is not None and devices != 1:
             raise ValueError(
                 "--devices requires a JAX backend (xla/pallas); "
-                "--backend cpu is the scalar oracle loop"
+                "--backend cpu is the single-process CPU loop"
             )
+        from .. import native
+
+        # Compiled C++ sweep (SHA-NI when the CPU has it) — the analogue of
+        # the Go reference riding stdlib assembly SHA-256; hashlib fallback.
+        if native.available():
+            return native.min_hash_range_native
         return min_hash_range
     if backend == "auto":
         backend = None  # let the ops layer pick pallas-on-TPU / xla elsewhere
@@ -61,9 +69,7 @@ def make_search(backend: str = "auto", devices: Optional[int] = None) -> SearchF
     return search
 
 
-def run_miner(
-    client: "lsp.Client", search: SearchFn
-) -> None:
+def run_miner(client: "lsp.Client", search: SearchFn) -> None:
     """Join and serve Requests until the server connection dies (the
     reference miner's intended lifetime: exit on server loss)."""
     client.write(Message.join().marshal())
@@ -82,6 +88,7 @@ def run_miner(
             # traceback mid-protocol; exit cleanly so the server reassigns.
             print(f"miner: search failed: {e!r}", file=sys.stderr)
             return
+        METRICS.inc("miner.nonces", msg.upper - msg.lower + 1)
         try:
             client.write(Message.result(h, n).marshal())
         except lsp.LspError:
@@ -111,10 +118,19 @@ def main(argv=None) -> int:
     except (lsp.LspError, OSError, ValueError) as e:
         print("Failed to join with server:", e)
         return 0
+    import time
+
+    t0 = time.monotonic()
     try:
         run_miner(client, search)
     finally:
         client.close()
+        swept = METRICS.get("miner.nonces")
+        dt = max(time.monotonic() - t0, 1e-9)
+        print(
+            f"miner: {swept} nonces swept ({swept / dt:,.0f}/s lifetime)",
+            file=sys.stderr,
+        )
     return 0
 
 

@@ -31,6 +31,7 @@ from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
 
 from ..bitcoin.message import Message
+from ..utils.metrics import METRICS
 
 Action = Tuple[int, Message]  # (conn_id, message to send)
 Interval = Tuple[int, int]  # inclusive [lower, upper]
@@ -144,6 +145,7 @@ class Scheduler:
                 # stay first (keeps the lowest-nonce tie-break cheap).
                 job.outstanding.pop(conn_id, None)
                 job.pending.appendleft(miner.interval)
+                METRICS.inc("sched.chunks_reassigned")
             return self._dispatch(now)
         job = self.jobs.pop(conn_id, None)
         if job is not None:
@@ -159,6 +161,7 @@ class Scheduler:
         del self.jobs[job.client_id]
         self._job_rr.remove(job.client_id)
         assert job.best is not None
+        METRICS.inc("sched.jobs_completed")
         return (job.client_id, Message.result(job.best[0], job.best[1]))
 
     def _chunk_size(self, miner: _Miner) -> int:
@@ -195,6 +198,7 @@ class Scheduler:
             miner.interval = (lo, cut)
             miner.assigned_at = now
             job.outstanding[miner.conn_id] = (lo, cut)
+            METRICS.inc("sched.chunks_assigned")
             actions.append((miner.conn_id, Message.request(job.data, lo, cut)))
         return actions
 

@@ -50,7 +50,7 @@ def serve(
         try:
             conn_id, payload = server.read()
         except lsp.ConnLostError as e:
-            log.info("connection %d lost", e.conn_id)
+            log.info("connection %d lost; %s", e.conn_id, sched.stats())
             emit(sched.lost(e.conn_id, clock()))
             continue
         except lsp.ConnClosedError:
@@ -61,7 +61,7 @@ def serve(
             continue
         now = clock()
         if msg.type == MsgType.JOIN:
-            log.info("miner %d joined", conn_id)
+            log.info("miner %d joined; %s", conn_id, sched.stats())
             emit(sched.miner_joined(conn_id, now))
         elif msg.type == MsgType.REQUEST:
             log.info(

@@ -29,6 +29,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional
 
+from ..utils.metrics import METRICS
 from .message import Message
 from .params import Params
 
@@ -110,8 +111,10 @@ class ConnCore:
         Size contract the reference never implemented, SURVEY §8.5)."""
         payload = msg.payload or b""
         if msg.size < 0:
+            METRICS.inc("lsp.dropped_bad_size")
             return  # nonsense Size (never produced by a real sender): drop
         if len(payload) < msg.size:
+            METRICS.inc("lsp.dropped_bad_size")
             return  # truncated in flight: drop silently, no ack
         if len(payload) > msg.size:
             payload = payload[: msg.size]
@@ -129,9 +132,11 @@ class ConnCore:
         if seq == self._expected:
             self._deliver(payload)
             self._expected += 1
+            METRICS.inc("lsp.delivered")
             while self._expected in self._reorder:
                 self._deliver(self._reorder.pop(self._expected))
                 self._expected += 1
+                METRICS.inc("lsp.delivered")
         else:
             self._reorder[seq] = payload
 
@@ -146,6 +151,7 @@ class ConnCore:
             return True
         # Retransmit all unacked in-window data (client_impl.go:360-368).
         for seq in sorted(self._unacked):
+            METRICS.inc("lsp.retransmits")
             self._send(self._unacked[seq])
         # Re-ack: seq 0 keepalive if no data yet, else last W received
         # (client_impl.go:370-380).

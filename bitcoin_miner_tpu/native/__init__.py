@@ -1,0 +1,108 @@
+"""Native (C++) CPU tier: compiled SHA-256 min-hash sweep.
+
+The reference's CPU hot loop rides Go's assembly SHA-256 (SURVEY §2.4);
+this package is the equivalent here — `sha256_sweep.cc` compiled on first
+use with the system ``g++`` and loaded via ctypes, giving the ``cpu`` miner
+backend real throughput (~10^7 nonces/s vs ~10^5 for the hashlib loop).
+If no compiler is available the caller falls back to the pure-Python
+oracle (``bitcoin_miner_tpu.bitcoin.min_hash_range``).
+
+Explicitly ctypes (not pybind11, which is not in this image); the .so is
+cached under ``~/.cache/bitcoin_miner_tpu`` keyed by source hash.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional, Tuple
+
+_SRC = Path(__file__).with_name("sha256_sweep.cc")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _cache_dir() -> Path:
+    d = Path(os.environ.get("XDG_CACHE_HOME", Path.home() / ".cache"))
+    return d / "bitcoin_miner_tpu"
+
+
+def _build() -> Optional[Path]:
+    src = _SRC.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = _cache_dir() / f"libsha256sweep-{tag}.so"
+    if out.exists():
+        return out
+    out.parent.mkdir(parents=True, exist_ok=True)
+    # Per-process tmp: concurrent first-use builders must not share a tmp
+    # path, or one process can promote another's half-written object.
+    tmp = out.with_suffix(f".so.tmp.{os.getpid()}")
+    base = ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)]
+    # SHA-NI build first (runtime-dispatched, so safe to *build* anywhere the
+    # flags are accepted); plain build as the portable fallback.
+    for extra in (["-msha", "-msse4.1", "-mssse3"], []):
+        try:
+            subprocess.run(base + extra, check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            continue
+        os.replace(tmp, out)  # atomic: concurrent builders race benignly
+        return out
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        path = _build()
+        if path is None:
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            _load_failed = True
+            return None
+        lib.sha256_sweep_min.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.sha256_sweep_min.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def min_hash_range_native(msg: str, lower: int, upper: int) -> Tuple[int, int]:
+    """Compiled scan of inclusive [lower, upper]; bit-exact vs the hashlib
+    oracle, lowest-nonce ties.  Raises RuntimeError if the native tier is
+    unavailable (callers check :func:`available` to fall back)."""
+    if lower > upper:
+        raise ValueError(f"empty nonce range [{lower}, {upper}]")
+    if lower < 0 or upper >= 1 << 64:
+        raise ValueError(f"nonce range out of uint64: [{lower}, {upper}]")
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native sha256 sweep unavailable (no compiler?)")
+    h = ctypes.c_uint64()
+    n = ctypes.c_uint64()
+    data = msg.encode("utf-8")
+    lib.sha256_sweep_min(
+        data, len(data), lower, upper, ctypes.byref(h), ctypes.byref(n)
+    )
+    return h.value, n.value

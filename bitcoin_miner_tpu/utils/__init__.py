@@ -1,0 +1,5 @@
+"""Shared utilities: process-wide metrics counters and rate meters."""
+
+from .metrics import METRICS, Metrics, RateMeter
+
+__all__ = ["METRICS", "Metrics", "RateMeter"]

@@ -1,0 +1,69 @@
+"""Process-wide counters — the observability layer (SURVEY §5).
+
+The reference has only debug prints; the survey's rebuild note asks for
+"structured logging plus a handful of counters (nonces/sec, retransmits,
+live miners)".  This is that: a tiny lock-protected counter registry that
+every layer increments and anything (server log, runner stderr, tests) can
+snapshot.  Deliberately not a metrics *server* — parity plus a little, not
+an ops stack.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = defaultdict(int)
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)  # no defaultdict insert on read
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+
+#: The process-wide registry.  Known counters:
+#:   lsp.retransmits       data messages resent on epoch ticks
+#:   lsp.delivered         in-order payloads handed to the application
+#:   lsp.dropped_bad_size  datagrams rejected by Size validation
+#:   sched.chunks_assigned     chunks handed to miners
+#:   sched.chunks_reassigned   chunks returned by dead miners
+#:   sched.jobs_completed      Results sent back to clients
+#:   miner.nonces              nonces swept by this process's miner loop
+METRICS = Metrics()
+
+
+class RateMeter:
+    """Lifetime events/second since construction (e.g. a miner process's
+    average nonces/sec)."""
+
+    def __init__(self, clock=time.monotonic) -> None:
+        self._clock = clock
+        self._t0 = clock()
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def add(self, n: int) -> None:
+        with self._lock:
+            self._n += n
+
+    def rate(self) -> float:
+        with self._lock:
+            dt = self._clock() - self._t0
+            return self._n / dt if dt > 0 else 0.0

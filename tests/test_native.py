@@ -1,0 +1,50 @@
+"""Native (C++) CPU sweep tier vs the hashlib oracle.
+
+The compiled tier is the framework's analogue of the reference's only
+native surface — Go's assembly SHA-256 under ``bitcoin/hash.go`` (SURVEY
+§2.4).  Bit-exactness matters most at the incremental-tail edge cases:
+digit-count rollover (re-pad), multi-block job data (midstate folding),
+and the uint64 ceiling.
+"""
+
+import pytest
+
+from bitcoin_miner_tpu import native
+from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain to build the native tier"
+)
+
+
+@pytest.mark.parametrize(
+    "data,lo,hi",
+    [
+        ("cmu440", 0, 5000),            # digit rollovers 1->2->3->4
+        ("x", 95, 1205),                # partial buckets both ends
+        ("", 0, 300),                   # empty job data
+        ("q" * 130, 1, 500),            # 3 constant blocks fold to midstate
+        ("pad55-" + "z" * 49, 90, 120), # prefix fills a block boundary
+        ("big", (1 << 64) - 51, (1 << 64) - 1),  # 20-digit ceiling
+        ("solo", 12345, 12345),         # single nonce
+    ],
+)
+def test_matches_oracle(data, lo, hi):
+    assert native.min_hash_range_native(data, lo, hi) == min_hash_range(data, lo, hi)
+
+
+def test_rollover_99999(it=99_990):
+    # crosses 5->6 digits mid-sweep: the tail layout is rebuilt in place
+    assert native.min_hash_range_native("r", it, 100_010) == min_hash_range(
+        "r", it, 100_010
+    )
+
+
+def test_empty_range_raises():
+    with pytest.raises(ValueError):
+        native.min_hash_range_native("x", 10, 9)
+
+
+def test_out_of_u64_raises():
+    with pytest.raises(ValueError):
+        native.min_hash_range_native("x", 0, 1 << 64)
