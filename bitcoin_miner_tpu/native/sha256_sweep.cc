@@ -61,10 +61,10 @@ void compress(uint32_t st[8], const uint8_t *block) {
   uint32_t e = st[4], f = st[5], g = st[6], h = st[7];
   for (int t = 0; t < 64; ++t) {
     uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
-    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t ch = g ^ (e & (f ^ g));
     uint32_t t1 = h + s1 + ch + K[t] + w[t];
     uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
-    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t maj = b ^ ((b ^ a) & (b ^ c));
     uint32_t t2 = s0 + maj;
     h = g; g = f; f = e; e = d + t1;
     d = c; c = b; b = a; a = t1 + t2;

@@ -37,6 +37,25 @@ class TestLayout:
         assert layout.n_tail_blocks < (130 + 1 + 3 + 9 + 63) // 64
 
 
+class TestCompress:
+    def test_unrolled_compress_matches_hashlib(self):
+        """Direct check of the Mosaic-path compression (scalar shapes compile
+        fast even on XLA:CPU) — the only CPU coverage of the unrolled form,
+        which otherwise runs exclusively on real TPU."""
+        import jax.numpy as jnp
+
+        from bitcoin_miner_tpu.ops.sha256 import H0, compress
+
+        msg = bytearray(64)
+        msg[:3] = b"abc"
+        msg[3] = 0x80
+        msg[-8:] = (24).to_bytes(8, "big")
+        w = [jnp.uint32(int.from_bytes(msg[i : i + 4], "big")) for i in range(0, 64, 4)]
+        out = compress(tuple(jnp.uint32(int(x)) for x in H0), w)
+        digest = b"".join(int(x).to_bytes(4, "big") for x in out)
+        assert digest == hashlib.sha256(b"abc").digest()
+
+
 class TestDecompose:
     def test_cover_exact_no_overlap(self):
         lower, upper = 7, 123456
