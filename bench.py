@@ -26,10 +26,22 @@ def log(*a) -> None:
 
 
 def main() -> int:
+    import argparse
+
     import jax
 
     from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
     from bitcoin_miner_tpu.ops.sweep import sweep_min_hash
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--profile",
+        metavar="DIR",
+        default=None,
+        help="capture a JAX profiler trace of the timed sweep into DIR "
+        "(view with tensorboard / xprof)",
+    )
+    args = ap.parse_args()
 
     platform = jax.default_backend()
     backend = "pallas" if platform == "tpu" else "xla"
@@ -71,6 +83,10 @@ def main() -> int:
     while dt < 4.0 and n < 4 * 10**9:
         n = min(n * max(2, int(4.0 / max(dt, 1e-3))), 4 * 10**9)
         dt = timed(n)
+    if args.profile:
+        with jax.profiler.trace(args.profile):
+            timed(n)
+        log(f"profiler trace written to {args.profile}")
     rate = n / dt
     log(f"swept {n} nonces in {dt:.3f}s -> {rate:,.0f} nonces/s")
 

@@ -95,6 +95,67 @@ def run_miner(client: "lsp.Client", search: SearchFn) -> None:
             return
 
 
+def run_miner_multihost(
+    hostport: str, coordinator: str, num_hosts: int, host_id: int
+) -> None:
+    """One logical miner spanning all hosts of a TPU pod (DCN scaling).
+
+    Every process executes the same sharded sweep over the global mesh
+    (multi-controller SPMD); only host 0 talks LSP to the scheduler and
+    broadcasts each Request's parameters to the other hosts.  See
+    parallel/multihost.py for when to prefer this over plain per-process
+    miners.
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from ..parallel import sweep_min_hash_sharded
+    from ..parallel.multihost import global_mesh, initialize, is_primary
+
+    initialize(coordinator, num_hosts, host_id)
+    mesh = global_mesh()
+    client = None
+    if is_primary():
+        host, _, port = hostport.rpartition(":")
+        client = lsp.Client(host or "127.0.0.1", int(port))
+        client.write(Message.join().marshal())
+
+    MAX_DATA = 960  # fits one LSP datagram alongside the other fields
+    while True:
+        # host 0 reads the next Request; everyone gets it via broadcast.
+        # Layout: [alive, lower_hi, lower_lo, upper_hi, upper_lo, dlen,
+        #          data bytes...], u32 halves because broadcast rides jax.
+        buf = np.zeros(6 + MAX_DATA, dtype=np.uint32)
+        if client is not None:
+            msg = None
+            while msg is None or msg.type != MsgType.REQUEST:
+                try:
+                    msg = Message.unmarshal(client.read())
+                except lsp.LspError:
+                    msg = None
+                    break
+            if msg is not None:
+                data = msg.data.encode("utf-8")[:MAX_DATA]
+                buf[0] = 1
+                buf[1], buf[2] = msg.lower >> 32, msg.lower & 0xFFFFFFFF
+                buf[3], buf[4] = msg.upper >> 32, msg.upper & 0xFFFFFFFF
+                buf[5] = len(data)
+                buf[6 : 6 + len(data)] = np.frombuffer(data, dtype=np.uint8)
+        buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        if buf[0] == 0:
+            return  # scheduler gone: the whole job exits together
+        lower = (int(buf[1]) << 32) | int(buf[2])
+        upper = (int(buf[3]) << 32) | int(buf[4])
+        data = bytes(buf[6 : 6 + int(buf[5])].astype(np.uint8)).decode("utf-8")
+        r = sweep_min_hash_sharded(data, lower, upper, mesh=mesh)
+        if client is not None:
+            METRICS.inc("miner.nonces", upper - lower + 1)
+            try:
+                client.write(Message.result(r.hash, r.nonce).marshal())
+            except lsp.LspError:
+                return
+
+
 def main(argv=None) -> int:
     argv = sys.argv if argv is None else argv
     if len(argv) < 2:
@@ -106,7 +167,19 @@ def main(argv=None) -> int:
         "--backend", choices=["auto", "pallas", "xla", "cpu"], default="auto"
     )
     parser.add_argument("--devices", type=int, default=None)
+    parser.add_argument("--multihost", action="store_true")
+    parser.add_argument("--coordinator", default=None)
+    parser.add_argument("--num-hosts", type=int, default=None)
+    parser.add_argument("--host-id", type=int, default=None)
     args = parser.parse_args(argv[1:])
+    if args.multihost:
+        if None in (args.coordinator, args.num_hosts, args.host_id):
+            print("--multihost requires --coordinator, --num-hosts, --host-id")
+            return 0
+        run_miner_multihost(
+            args.hostport, args.coordinator, args.num_hosts, args.host_id
+        )
+        return 0
     try:
         search = make_search(args.backend, args.devices)
     except ValueError as e:

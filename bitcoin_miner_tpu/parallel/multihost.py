@@ -1,0 +1,66 @@
+"""Multi-host (DCN) scaling for a single miner worker.
+
+Two distinct scaling axes exist in this framework (SURVEY §2.3):
+
+1. **Process parallelism over LSP** — the reference's model: every miner
+   process is an independent worker; the scheduler splits ranges across
+   them.  This is the default and right answer for scaling out, because
+   the workload is embarrassingly parallel and the min-fold is tiny.
+2. **One logical worker spanning hosts** — this module: all hosts of a
+   TPU pod join one `jax.distributed` job, build a global mesh over every
+   chip, and run the sharded sweep (parallel/sweep.py) with its pmin
+   cascade riding ICI within a slice and DCN across hosts.  XLA owns the
+   transport — there is no hand-rolled NCCL/MPI analogue to port, by
+   design.
+
+Use (2) when one job must appear as a single ultra-fast miner to the
+scheduler (e.g. BASELINE's v5e-8+ sweeps driven by one Request); use (1)
+otherwise.  Run the same CLI on every host::
+
+    python -m bitcoin_miner_tpu.apps.miner host:port --multihost \
+        --coordinator <host0>:1234 --num-hosts N --host-id I
+
+Only host 0 opens the LSP connection to the scheduler; the others run the
+same jitted computation via XLA's SPMD launch (standard multi-controller
+JAX: every process executes the same program on its local devices).
+
+Single-host environments can't exercise this path; it is kept thin and
+structurally identical to the single-host sharded sweep so the CPU-mesh
+tests of parallel/sweep.py cover the program logic, and only the
+`jax.distributed.initialize` wiring is environment-specific.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .mesh import MINER_AXIS
+from jax.sharding import Mesh
+
+
+def initialize(
+    coordinator: str, num_hosts: int, host_id: int
+) -> None:
+    """Join this process to the multi-host JAX job (idempotent)."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_hosts,
+        process_id=host_id,
+    )
+
+
+def global_mesh(axis_name: str = MINER_AXIS) -> Mesh:
+    """A 1-D mesh over every chip of every host in the job.
+
+    The sweep's chunk batch shards contiguously across it exactly as on a
+    single host — XLA places the pmin cascade's reduction tree so the
+    intra-host stages ride ICI and only the final stage crosses DCN.
+    """
+    return Mesh(list(jax.devices()), (axis_name,))
+
+
+def is_primary() -> bool:
+    """True on the host that should own the LSP connection."""
+    return jax.process_index() == 0

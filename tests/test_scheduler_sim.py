@@ -1,0 +1,118 @@
+"""Randomized scheduler simulation: correctness under adversarial event
+interleavings.
+
+Drives the pure Scheduler with random joins, miner deaths, completions and
+multiple concurrent clients, with a deterministic stand-in hash (the
+scheduler is hash-agnostic — only the min-fold and range bookkeeping are
+under test).  Invariant: every client that stays alive receives exactly the
+min over its full [0, maxNonce] range, no matter which miners died when.
+"""
+
+import random
+
+import pytest
+
+from bitcoin_miner_tpu.apps.scheduler import Scheduler
+from bitcoin_miner_tpu.bitcoin.message import MsgType
+
+U64 = (1 << 64) - 1
+
+
+def fake_hash(nonce: int) -> int:
+    return (nonce * 2654435761) ^ (nonce >> 3) & U64
+
+
+def fake_min(lo: int, hi: int):
+    best = min(range(lo, hi + 1), key=lambda n: (fake_hash(n), n))
+    return fake_hash(best), best
+
+
+@pytest.mark.parametrize("seed", [1, 7, 42, 1234, 9999])
+def test_random_interleavings_converge_correctly(seed):
+    rng = random.Random(seed)
+    sched = Scheduler(min_chunk=rng.choice([13, 50, 128]), max_chunk=500)
+
+    next_id = [1]
+    miners = {}   # conn_id -> current (lo, hi) or None
+    results = {}  # client_id -> (hash, nonce)
+    jobs = {}     # client_id -> max_nonce
+    now = [0.0]
+
+    def apply(actions):
+        for cid, msg in actions:
+            if msg.type == MsgType.REQUEST:
+                assert cid in miners, "request sent to a non-miner"
+                assert miners[cid] is None, "miner double-assigned"
+                miners[cid] = (msg.lower, msg.upper)
+            elif msg.type == MsgType.RESULT:
+                assert cid in jobs, "result sent to unknown client"
+                results[cid] = (msg.hash, msg.nonce)
+
+    def tick():
+        now[0] += rng.random()
+        return now[0]
+
+    # Seed the system with a couple of clients and miners.
+    for _ in range(rng.randint(2, 4)):
+        cid = next_id[0]; next_id[0] += 1
+        mx = rng.randint(0, 700)
+        jobs[cid] = mx
+        apply(sched.client_request(cid, f"job{cid}", 0, mx, tick()))
+
+    steps = 0
+    while len(results) < len(jobs) and steps < 10_000:
+        steps += 1
+        busy = [m for m, iv in miners.items() if iv is not None and m in sched.miners]
+        choice = rng.random()
+        if choice < 0.25 or not busy:
+            mid = next_id[0]; next_id[0] += 1
+            miners[mid] = None
+            apply(sched.miner_joined(mid, tick()))
+        elif choice < 0.40 and busy:
+            mid = rng.choice(busy)  # kill a busy miner mid-chunk
+            miners.pop(mid)
+            apply(sched.lost(mid, tick()))
+        else:
+            mid = rng.choice(busy)  # miner completes its chunk
+            lo, hi = miners[mid]
+            h, n = fake_min(lo, hi)
+            miners[mid] = None
+            apply(sched.result(mid, h, n, tick()))
+
+    assert len(results) == len(jobs), f"jobs never completed (seed={seed})"
+    for cid, mx in jobs.items():
+        assert results[cid] == fake_min(0, mx), f"wrong min for client {cid}"
+    assert sched.jobs == {}
+
+
+def test_client_death_mid_sim():
+    rng = random.Random(5)
+    sched = Scheduler(min_chunk=20, max_chunk=100)
+    sched.client_request(100, "a", 0, 500)
+    sched.client_request(101, "b", 0, 400)
+    miners = {}
+    results = {}
+
+    def apply(actions):
+        for cid, msg in actions:
+            if msg.type == MsgType.REQUEST:
+                miners[cid] = (msg.lower, msg.upper)
+            elif msg.type == MsgType.RESULT:
+                results[cid] = (msg.hash, msg.nonce)
+
+    for mid in (1, 2, 3):
+        miners[mid] = None
+        apply(sched.miner_joined(mid))
+    apply(sched.lost(100))  # client a dies mid-job
+    for _ in range(200):
+        busy = [m for m, iv in miners.items() if iv is not None]
+        if not busy:
+            break
+        mid = rng.choice(busy)
+        lo, hi = miners[mid]
+        h, n = fake_min(lo, hi)
+        miners[mid] = None
+        apply(sched.result(mid, h, n))
+    assert 100 not in results, "dead client must not receive a Result"
+    assert results[101] == fake_min(0, 400)
+    assert sched.jobs == {}
