@@ -2,21 +2,34 @@
 
 Measures the flagship workload — the BASELINE config-1/2 job shape
 (``data='cmu440'``), swept with the fastest available tier (Pallas on TPU,
-fused-jnp elsewhere) — and prints ONE JSON line::
+fused-jnp elsewhere) — and always prints exactly ONE JSON line on stdout::
 
     {"metric": "nonces_per_sec_per_chip", "value": N, "unit": "nonces/s",
-     "vs_baseline": N / 1e9}
+     "vs_baseline": N / 1e9, "platform": ..., "device_kind": ...,
+     "backend": ...}
 
 ``vs_baseline`` is the ratio to the north-star target of 1e9 nonces/sec/chip
 (BASELINE.json:5; the reference itself publishes no numbers — BASELINE.md).
+
+Robustness (the round-1 bench died with rc=1 and no JSON when the TPU
+tunnel refused to initialize): backend init is probed in a SUBPROCESS with
+a hard timeout and retried with backoff — the tunnel can both error
+(UNAVAILABLE) and hang indefinitely, and a hang in the PJRT client cannot
+be recovered in-process.  If the accelerator never comes up, the benchmark
+falls back to the CPU backend so a number (attributed ``platform="cpu"``)
+still lands; if even that fails, the JSON line carries ``{"error": ...}``.
+Diagnostics go to stderr; stdout carries only the JSON line.
+
 Before timing, the run bit-exactness-checks the kernel against the hashlib
 oracle on a digit-boundary-crossing range; a mismatch aborts the benchmark.
-Diagnostics go to stderr; stdout carries only the JSON line.
+Correctness contract: ``Hash = BigEndian.Uint64(SHA256("<data> <nonce>")
+[:8])`` per the reference ``bitcoin/hash.go:13-17``.
 """
 
 from __future__ import annotations
 
 import json
+import subprocess
 import sys
 import time
 
@@ -25,13 +38,55 @@ def log(*a) -> None:
     print(*a, file=sys.stderr, flush=True)
 
 
+def emit(obj) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+_PROBE = (
+    "import jax; d = jax.devices()[0]; "
+    "print('|'.join([d.platform, getattr(d, 'device_kind', '') or '']))"
+)
+
+
+def probe_accelerator(attempts: int = 3, timeout: float = 100.0):
+    """Try to initialize the default (accelerator) backend in a subprocess.
+
+    Returns ``(platform, device_kind)`` on success, else ``None``.  Run in a
+    child so a wedged PJRT client can be killed; retried with backoff since
+    the tunnel flakes transiently.
+    """
+    last_err = "?"
+    for i in range(attempts):
+        if i:
+            delay = 10.0 * i
+            log(f"backend probe retry {i + 1}/{attempts} in {delay:.0f}s")
+            time.sleep(delay)
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", _PROBE],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"probe hung >{timeout:.0f}s (wedged PJRT init)"
+            log(last_err)
+            continue
+        if p.returncode == 0:
+            # Scan from the end: startup noise may precede the probe line.
+            for line in reversed(p.stdout.strip().splitlines()):
+                if "|" in line:
+                    platform, kind = line.split("|", 1)
+                    return platform, kind
+        lines = (p.stderr or p.stdout).strip().splitlines()
+        last_err = lines[-1] if lines else "rc!=0"
+        log(f"probe attempt {i + 1} failed: {last_err}")
+    log(f"accelerator unavailable after {attempts} attempts: {last_err}")
+    return None
+
+
 def main() -> int:
     import argparse
-
-    import jax
-
-    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
-    from bitcoin_miner_tpu.ops.sweep import sweep_min_hash
 
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -41,11 +96,38 @@ def main() -> int:
         help="capture a JAX profiler trace of the timed sweep into DIR "
         "(view with tensorboard / xprof)",
     )
+    ap.add_argument(
+        "--cpu",
+        action="store_true",
+        help="skip the accelerator probe and bench the CPU backend",
+    )
     args = ap.parse_args()
 
-    platform = jax.default_backend()
-    backend = "pallas" if platform == "tpu" else "xla"
-    log(f"platform={platform} devices={len(jax.devices())} backend={backend}")
+    warning = None
+    probed = None if args.cpu else probe_accelerator()
+    if probed is None and not args.cpu:
+        warning = "accelerator backend unavailable; CPU fallback number"
+        log(f"WARNING: {warning}")
+
+    import jax
+
+    if probed is None:
+        # Force CPU before any backend init (env vars are too late here:
+        # sitecustomize imports jax at boot with the TPU plugin selected).
+        jax.config.update("jax_platforms", "cpu")
+
+    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+    from bitcoin_miner_tpu.ops.sweep import sweep_min_hash
+    from bitcoin_miner_tpu.utils.platform import device_desc, is_tpu
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    device_kind = getattr(dev, "device_kind", "") or ""
+    backend = "pallas" if is_tpu() else "xla"
+    log(
+        f"platform={platform} device={device_desc(dev)} "
+        f"devices={len(jax.devices())} backend={backend}"
+    )
 
     # -- correctness gate ---------------------------------------------------
     data = "cmu440"
@@ -59,6 +141,15 @@ def main() -> int:
     expect = min_hash_range(data, lo, hi)
     if (r.hash, r.nonce) != expect:
         log(f"CORRECTNESS FAILURE: kernel {(r.hash, r.nonce)} oracle {expect}")
+        emit(
+            {
+                "error": "correctness gate failed",
+                "kernel": [r.hash, r.nonce],
+                "oracle": list(expect),
+                "platform": platform,
+                "backend": backend,
+            }
+        )
         return 1
     log(f"correctness OK: hash={r.hash} nonce={r.nonce}")
 
@@ -90,18 +181,27 @@ def main() -> int:
     rate = n / dt
     log(f"swept {n} nonces in {dt:.3f}s -> {rate:,.0f} nonces/s")
 
-    print(
-        json.dumps(
-            {
-                "metric": "nonces_per_sec_per_chip",
-                "value": round(rate),
-                "unit": "nonces/s",
-                "vs_baseline": round(rate / 1e9, 4),
-            }
-        )
-    )
+    out = {
+        "metric": "nonces_per_sec_per_chip",
+        "value": round(rate),
+        "unit": "nonces/s",
+        "vs_baseline": round(rate / 1e9, 4),
+        "platform": platform,
+        "device_kind": device_kind,
+        "backend": backend,
+    }
+    if warning:
+        out["warning"] = warning
+    emit(out)
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except Exception as e:  # last-ditch: never exit without a JSON line
+        import traceback
+
+        traceback.print_exc()
+        emit({"error": f"{type(e).__name__}: {e}"})
+        sys.exit(1)

@@ -95,6 +95,55 @@ def run_miner(client: "lsp.Client", search: SearchFn) -> None:
             return
 
 
+def serve_multihost(client, sweep: SearchFn, broadcast) -> None:
+    """The primary/secondary Request loop of a multi-host logical miner.
+
+    ``client`` is the primary host's LSP connection (None on secondaries);
+    ``sweep(data, lower, upper) -> (hash, nonce)`` is the collective sweep
+    every host executes in lockstep; ``broadcast(buf) -> buf`` is the
+    host-0-to-all collective.  Factored out of :func:`run_miner_multihost`
+    (which supplies the real jax.distributed wiring) so the protocol logic
+    is unit-testable on one host.
+    """
+    from ..parallel.multihost import (
+        decode_request,
+        encode_request,
+        encode_shutdown,
+    )
+
+    while True:
+        # host 0 reads the next Request; everyone gets it via broadcast.
+        buf = encode_shutdown()
+        if client is not None:
+            msg = None
+            while msg is None or msg.type != MsgType.REQUEST:
+                try:
+                    msg = Message.unmarshal(client.read())
+                except lsp.LspError:
+                    msg = None
+                    break
+            if msg is not None:
+                try:
+                    buf = encode_request(msg.data, msg.lower, msg.upper)
+                except ValueError as e:
+                    # Un-broadcastable Request (e.g. oversize data): refuse
+                    # loudly — a truncated sweep would return a plausible
+                    # but WRONG Result.  Shut the whole logical miner down;
+                    # the dropped conn makes the scheduler reassign.
+                    print(f"miner: rejecting request: {e}", file=sys.stderr)
+        req = decode_request(broadcast(buf))
+        if req is None:
+            return  # scheduler gone / fatal request: all hosts exit together
+        data, lower, upper = req
+        h, n = sweep(data, lower, upper)
+        if client is not None:
+            METRICS.inc("miner.nonces", upper - lower + 1)
+            try:
+                client.write(Message.result(h, n).marshal())
+            except lsp.LspError:
+                return
+
+
 def run_miner_multihost(
     hostport: str, coordinator: str, num_hosts: int, host_id: int
 ) -> None:
@@ -120,40 +169,14 @@ def run_miner_multihost(
         client = lsp.Client(host or "127.0.0.1", int(port))
         client.write(Message.join().marshal())
 
-    MAX_DATA = 960  # fits one LSP datagram alongside the other fields
-    while True:
-        # host 0 reads the next Request; everyone gets it via broadcast.
-        # Layout: [alive, lower_hi, lower_lo, upper_hi, upper_lo, dlen,
-        #          data bytes...], u32 halves because broadcast rides jax.
-        buf = np.zeros(6 + MAX_DATA, dtype=np.uint32)
-        if client is not None:
-            msg = None
-            while msg is None or msg.type != MsgType.REQUEST:
-                try:
-                    msg = Message.unmarshal(client.read())
-                except lsp.LspError:
-                    msg = None
-                    break
-            if msg is not None:
-                data = msg.data.encode("utf-8")[:MAX_DATA]
-                buf[0] = 1
-                buf[1], buf[2] = msg.lower >> 32, msg.lower & 0xFFFFFFFF
-                buf[3], buf[4] = msg.upper >> 32, msg.upper & 0xFFFFFFFF
-                buf[5] = len(data)
-                buf[6 : 6 + len(data)] = np.frombuffer(data, dtype=np.uint8)
-        buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
-        if buf[0] == 0:
-            return  # scheduler gone: the whole job exits together
-        lower = (int(buf[1]) << 32) | int(buf[2])
-        upper = (int(buf[3]) << 32) | int(buf[4])
-        data = bytes(buf[6 : 6 + int(buf[5])].astype(np.uint8)).decode("utf-8")
+    def sweep(data: str, lower: int, upper: int) -> Tuple[int, int]:
         r = sweep_min_hash_sharded(data, lower, upper, mesh=mesh)
-        if client is not None:
-            METRICS.inc("miner.nonces", upper - lower + 1)
-            try:
-                client.write(Message.result(r.hash, r.nonce).marshal())
-            except lsp.LspError:
-                return
+        return r.hash, r.nonce
+
+    def broadcast(buf):
+        return np.asarray(multihost_utils.broadcast_one_to_all(buf))
+
+    serve_multihost(client, sweep, broadcast)
 
 
 def main(argv=None) -> int:

@@ -19,6 +19,21 @@ Design notes (deliberately not a translation of anything):
   assignment carves a chunk sized to the miner's EWMA nonces/sec so every
   chunk targets ``target_chunk_seconds`` of work.  New miners start at
   ``min_chunk`` and ramp as rates are observed.
+- **Result validation.** Every Result is re-checked with one hashlib call
+  (``hash_nonce(data, nonce) == hash`` and nonce within the assigned
+  interval) before folding — a lying or bit-flipping miner tier cannot
+  silently corrupt a job's answer.  Rejected Results re-queue the chunk;
+  ``max_rejects`` strikes evict the miner.
+- **Straggler recovery.** The epoch heartbeat only detects dead *conns*;
+  a live-but-hung miner (e.g. a wedged TPU runtime) would stall its chunk
+  forever.  ``tick(now)`` re-queues chunks held ≳ ``straggler_factor`` ×
+  their expected duration; first Result wins, the loser just idles.
+- **Checkpoint/resume** (beyond reference parity, SURVEY §5): completed
+  work is durable as "the complement of what remains" — ``checkpoint()``
+  snapshots each job's remaining intervals + best-so-far keyed by the job
+  signature ``(data, lower, upper)``; a restarted scheduler given that
+  state resumes a resubmitted identical Request without re-sweeping
+  finished sub-ranges.
 - **Lowest-nonce tie-break** on equal min-hashes, matching the kernels
   (BASELINE.md).
 - **Fairness**: round-robin across jobs with pending work.
@@ -30,11 +45,14 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
 
+from ..bitcoin.hash import hash_nonce
 from ..bitcoin.message import Message
 from ..utils.metrics import METRICS
 
 Action = Tuple[int, Message]  # (conn_id, message to send)
 Interval = Tuple[int, int]  # inclusive [lower, upper]
+
+JobKey = Tuple[str, int, int]  # (data, lower, upper) — checkpoint identity
 
 
 @dataclass
@@ -44,14 +62,21 @@ class _Miner:
     interval: Optional[Interval] = None
     assigned_at: float = 0.0
     rate: float = 0.0  # EWMA nonces/sec; 0 = unknown
+    timed_out: bool = False  # chunk reclaimed by the straggler tick
+    rejects: int = 0  # invalid Results so far (strikes)
 
 
 @dataclass
 class _Job:
     client_id: int
     data: str
+    lower: int
+    upper: int
     pending: Deque[Interval] = field(default_factory=deque)
     outstanding: Dict[int, Interval] = field(default_factory=dict)
+    # Straggler-reclaimed intervals, by the slow miner's conn_id: if its
+    # Result does arrive first, the duplicate pending copy is withdrawn.
+    requeued: Dict[int, Interval] = field(default_factory=dict)
     best: Optional[Tuple[int, int]] = None  # (hash, nonce)
 
     def fold(self, hash_: int, nonce: int) -> None:
@@ -62,6 +87,10 @@ class _Job:
     @property
     def done(self) -> bool:
         return not self.pending and not self.outstanding
+
+    @property
+    def key(self) -> JobKey:
+        return (self.data, self.lower, self.upper)
 
 
 class Scheduler:
@@ -74,20 +103,38 @@ class Scheduler:
         max_chunk: int = 10**9,
         target_chunk_seconds: float = 0.5,
         rate_alpha: float = 0.5,
+        validate_results: bool = True,
+        max_rejects: int = 3,
+        straggler_factor: float = 4.0,
+        straggler_min_seconds: float = 10.0,
+        resume_state: Optional[dict] = None,
     ) -> None:
         self.min_chunk = min_chunk
         self.max_chunk = max_chunk
         self.target_chunk_seconds = target_chunk_seconds
         self.rate_alpha = rate_alpha
+        self.validate_results = validate_results
+        self.max_rejects = max_rejects
+        self.straggler_factor = straggler_factor
+        self.straggler_min_seconds = straggler_min_seconds
         self.miners: Dict[int, _Miner] = {}
         self.jobs: Dict[int, _Job] = {}
         self._job_rr: Deque[int] = deque()  # round-robin order of job ids
+        self._banned: set = set()  # evicted conn ids: Joins refused for good
+        self._evicted: List[int] = []  # conns the shell should close
+        # Checkpointed progress awaiting a matching resubmitted Request:
+        # job key -> (best, remaining intervals).
+        self._resume: Dict[JobKey, Tuple[Optional[Tuple[int, int]], List[Interval]]] = {}
+        if resume_state is not None:
+            self.load_checkpoint(resume_state)
 
     # ------------------------------------------------------------------ events
 
     def miner_joined(self, conn_id: int, now: float = 0.0) -> List[Action]:
         if conn_id in self.miners or conn_id in self.jobs:
             return []  # duplicate Join / role confusion: ignore
+        if conn_id in self._banned:
+            return []  # evicted liar re-Joining on the same conn: refuse
         self.miners[conn_id] = _Miner(conn_id)
         return self._dispatch(now)
 
@@ -98,15 +145,22 @@ class Scheduler:
             return []  # one job per client conn; ignore repeats
         if lower < 0 or upper >= 1 << 64:
             return []  # defense in depth; Message.unmarshal already rejects
-        job = _Job(client_id=conn_id, data=data)
-        if lower <= upper:
+        job = _Job(client_id=conn_id, data=data, lower=lower, upper=upper)
+        resumed = self._resume.pop(job.key, None)
+        if resumed is not None:
+            best, remaining = resumed
+            job.best = best
+            job.pending.extend(remaining)
+            METRICS.inc("sched.jobs_resumed")
+        elif lower <= upper:
             job.pending.append((lower, upper))
         self.jobs[conn_id] = job
         self._job_rr.append(conn_id)
-        if job.done:  # degenerate empty range: answer immediately
+        if job.done:  # empty range, or checkpoint says fully swept
             del self.jobs[conn_id]
             self._job_rr.remove(conn_id)
-            return [(conn_id, Message.result(0, 0))]
+            best = job.best or (0, 0)
+            return [(conn_id, Message.result(best[0], best[1]))]
         return self._dispatch(now)
 
     def result(
@@ -116,6 +170,13 @@ class Scheduler:
         if miner is None or miner.interval is None:
             return []  # Result from a non-miner or an unassigned miner
         lo, hi = miner.interval
+        job = self.jobs.get(miner.job)  # None if the client died meanwhile
+
+        if job is not None and self.validate_results:
+            valid = lo <= nonce <= hi and hash_nonce(job.data, nonce) == hash_
+            if not valid:
+                return self._reject_result(miner, job, now)
+
         elapsed = max(now - miner.assigned_at, 1e-6)
         sample = (hi - lo + 1) / elapsed
         miner.rate = (
@@ -123,12 +184,23 @@ class Scheduler:
             if miner.rate == 0.0
             else self.rate_alpha * sample + (1 - self.rate_alpha) * miner.rate
         )
-        job = self.jobs.get(miner.job)  # None if the client died meanwhile
+        was_timed_out = miner.timed_out
         miner.job = None
         miner.interval = None
+        miner.timed_out = False
         actions: List[Action] = []
         if job is not None:
             job.outstanding.pop(conn_id, None)
+            if was_timed_out:
+                # The slow miner finished after all: withdraw whatever of
+                # its re-queued duplicate is still pending.  Dispatch may
+                # have split the duplicate into differently-shaped chunks,
+                # so subtract the interval rather than matching it whole
+                # (parts already handed to other miners are re-swept; the
+                # min-fold makes that harmless).
+                dup = job.requeued.pop(conn_id, None)
+                if dup is not None:
+                    _subtract_pending(job, dup)
             job.fold(hash_, nonce)
             if job.done:
                 actions.append(self._finish_job(job))
@@ -141,11 +213,14 @@ class Scheduler:
         if miner is not None:
             job = self.jobs.get(miner.job) if miner.job is not None else None
             if job is not None and miner.interval is not None:
-                # Reassign: return the chunk to the *front* so low nonces
-                # stay first (keeps the lowest-nonce tie-break cheap).
                 job.outstanding.pop(conn_id, None)
-                job.pending.appendleft(miner.interval)
-                METRICS.inc("sched.chunks_reassigned")
+                job.requeued.pop(conn_id, None)
+                if not miner.timed_out:
+                    # Reassign: return the chunk to the *front* so low nonces
+                    # stay first (keeps the lowest-nonce tie-break cheap).
+                    # (A timed-out miner's chunk was already re-queued.)
+                    job.pending.appendleft(miner.interval)
+                    METRICS.inc("sched.chunks_reassigned")
             return self._dispatch(now)
         job = self.jobs.pop(conn_id, None)
         if job is not None:
@@ -155,7 +230,105 @@ class Scheduler:
             # job and simply idle them (see result()).
         return []
 
+    def tick(self, now: float) -> List[Action]:
+        """Periodic straggler scan: re-queue chunks held far past their
+        expected duration by a live-but-hung miner.  First Result wins —
+        the loser's late Result just withdraws the duplicate and idles it.
+        """
+        reclaimed = False
+        for miner in self.miners.values():
+            if miner.interval is None or miner.timed_out:
+                continue
+            lo, hi = miner.interval
+            expected = (
+                (hi - lo + 1) / miner.rate
+                if miner.rate > 0.0
+                else self.target_chunk_seconds
+            )
+            deadline = miner.assigned_at + max(
+                self.straggler_factor * expected, self.straggler_min_seconds
+            )
+            if now < deadline:
+                continue
+            job = self.jobs.get(miner.job)
+            if job is None:
+                continue
+            miner.timed_out = True
+            job.outstanding.pop(miner.conn_id, None)
+            job.pending.appendleft(miner.interval)
+            job.requeued[miner.conn_id] = miner.interval
+            METRICS.inc("sched.chunks_straggler_requeued")
+            reclaimed = True
+        return self._dispatch(now) if reclaimed else []
+
+    # ------------------------------------------------------------------ checkpoint
+
+    def checkpoint(self) -> dict:
+        """Snapshot resumable progress: every live job's best-so-far and its
+        remaining (pending + outstanding + previously checkpointed) work.
+        JSON-serializable; feed to ``load_checkpoint`` / ``resume_state``.
+        """
+        jobs = []
+        for job in self.jobs.values():
+            remaining = list(job.pending) + list(job.outstanding.values())
+            jobs.append(
+                {
+                    "data": job.data,
+                    "lower": job.lower,
+                    "upper": job.upper,
+                    "best": list(job.best) if job.best else None,
+                    "remaining": [list(iv) for iv in _merge_intervals(remaining)],
+                }
+            )
+        # Orphaned progress (job's client died / fleet restarted) persists too.
+        for key, (best, remaining) in self._resume.items():
+            jobs.append(
+                {
+                    "data": key[0],
+                    "lower": key[1],
+                    "upper": key[2],
+                    "best": list(best) if best else None,
+                    "remaining": [list(iv) for iv in remaining],
+                }
+            )
+        return {"version": 1, "jobs": jobs}
+
+    def load_checkpoint(self, state: dict) -> None:
+        """Stage checkpointed progress; consumed when a client resubmits the
+        identical ``(data, lower, upper)`` Request."""
+        for j in state.get("jobs", ()):
+            key = (j["data"], j["lower"], j["upper"])
+            best = tuple(j["best"]) if j.get("best") else None
+            remaining = [tuple(iv) for iv in j["remaining"]]
+            self._resume[key] = (best, remaining)
+
     # ------------------------------------------------------------------ internals
+
+    def _reject_result(
+        self, miner: _Miner, job: _Job, now: float
+    ) -> List[Action]:
+        """Invalid Result: drop it, re-queue the chunk, strike the miner."""
+        METRICS.inc("sched.results_rejected")
+        miner.rejects += 1
+        interval = miner.interval
+        was_timed_out = miner.timed_out
+        miner.job = None
+        miner.interval = None
+        miner.timed_out = False
+        job.outstanding.pop(miner.conn_id, None)
+        if was_timed_out:
+            # Chunk already re-queued by the straggler tick; keep that copy.
+            job.requeued.pop(miner.conn_id, None)
+        else:
+            job.pending.appendleft(interval)
+        if miner.rejects >= self.max_rejects:
+            METRICS.inc("sched.miners_evicted")
+            del self.miners[miner.conn_id]
+            # Ban the conn (a re-Join would reset the strike count) and ask
+            # the shell to close it via drain_evictions().
+            self._banned.add(miner.conn_id)
+            self._evicted.append(miner.conn_id)
+        return self._dispatch(now)
 
     def _finish_job(self, job: _Job) -> Action:
         del self.jobs[job.client_id]
@@ -184,7 +357,9 @@ class Scheduler:
         actions: List[Action] = []
         idle = [m for m in self.miners.values() if m.job is None]
         # Fastest miners first: they drain the most work per assignment.
-        idle.sort(key=lambda m: -m.rate)
+        # Miners with validation strikes sort last — a re-queued chunk should
+        # land on a trustworthy peer, not bounce back to the liar.
+        idle.sort(key=lambda m: (m.rejects, -m.rate))
         for miner in idle:
             job = self._next_job()
             if job is None:
@@ -202,6 +377,12 @@ class Scheduler:
             actions.append((miner.conn_id, Message.request(job.data, lo, cut)))
         return actions
 
+    def drain_evictions(self) -> List[int]:
+        """Conn ids evicted since the last drain — the transport shell
+        should close each one (the pure scheduler can't touch sockets)."""
+        out, self._evicted = self._evicted, []
+        return out
+
     # ------------------------------------------------------------------ metrics
 
     def stats(self) -> Dict[str, int]:
@@ -214,3 +395,31 @@ class Scheduler:
                 len(j.outstanding) for j in self.jobs.values()
             ),
         }
+
+
+def _subtract_pending(job: _Job, cut: Interval) -> None:
+    """Remove every part of ``cut`` from the job's pending queue, keeping
+    non-overlapping remainders in order (inclusive-interval subtraction)."""
+    lo, hi = cut
+    kept: Deque[Interval] = deque()
+    for plo, phi in job.pending:
+        if phi < lo or plo > hi:
+            kept.append((plo, phi))
+            continue
+        if plo < lo:
+            kept.append((plo, lo - 1))
+        if phi > hi:
+            kept.append((hi + 1, phi))
+    job.pending = kept
+
+
+def _merge_intervals(intervals: List[Interval]) -> List[Interval]:
+    """Coalesce overlapping/adjacent inclusive intervals (checkpoint hygiene:
+    straggler duplicates must not double-count work on resume)."""
+    out: List[Interval] = []
+    for lo, hi in sorted(intervals):
+        if out and lo <= out[-1][1] + 1:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out

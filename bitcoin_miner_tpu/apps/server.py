@@ -15,8 +15,11 @@ actions are put on the wire.
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -25,17 +28,42 @@ from ..bitcoin.message import Message, MsgType
 from .scheduler import Scheduler
 
 
+def save_checkpoint(path: str, state: dict) -> None:
+    """Atomically persist a scheduler checkpoint (write temp + rename, so a
+    crash mid-write never corrupts the resume file)."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
 def serve(
     server: "lsp.Server",
     scheduler: Optional[Scheduler] = None,
     log: Optional[logging.Logger] = None,
     clock=time.monotonic,
+    tick_interval: float = 1.0,
+    checkpoint_path: Optional[str] = None,
 ) -> None:
     """Run the scheduler loop over an already-listening LSP server until the
     server is closed.  Factored out of main() so tests drive it in-process.
+
+    A timer thread fires :meth:`Scheduler.tick` every ``tick_interval``
+    seconds (straggler reclamation — ``server.read()`` blocks, so the scan
+    can't live on the read loop) and, if ``checkpoint_path`` is set,
+    persists the scheduler's resumable progress there.
     """
     sched = scheduler if scheduler is not None else Scheduler()
     log = log or logging.getLogger("bitcoin_miner_tpu.server")
+    lock = threading.Lock()  # serializes scheduler access with the ticker
 
     def emit(actions) -> None:
         for conn_id, msg in actions:
@@ -46,31 +74,71 @@ def serve(
                 # event will arrive via read() and trigger reassignment.
                 log.info("write to %d failed (conn dead)", conn_id)
 
-    while True:
-        try:
-            conn_id, payload = server.read()
-        except lsp.ConnLostError as e:
-            log.info("connection %d lost; %s", e.conn_id, sched.stats())
-            emit(sched.lost(e.conn_id, clock()))
-            continue
-        except lsp.ConnClosedError:
-            return
-        msg = Message.unmarshal(payload)
-        if msg is None:
-            log.warning("undecodable payload from %d", conn_id)
-            continue
-        now = clock()
-        if msg.type == MsgType.JOIN:
-            log.info("miner %d joined; %s", conn_id, sched.stats())
-            emit(sched.miner_joined(conn_id, now))
-        elif msg.type == MsgType.REQUEST:
-            log.info(
-                "request from %d: data=%r range=[%d,%d]",
-                conn_id, msg.data, msg.lower, msg.upper,
-            )
-            emit(sched.client_request(conn_id, msg.data, msg.lower, msg.upper, now))
-        elif msg.type == MsgType.RESULT:
-            emit(sched.result(conn_id, msg.hash, msg.nonce, now))
+    stop = threading.Event()
+
+    def ticker() -> None:
+        while not stop.wait(tick_interval):
+            try:
+                with lock:
+                    actions = sched.tick(clock())
+                    state = sched.checkpoint() if checkpoint_path else None
+                if actions:
+                    log.info("straggler tick reclaimed work")
+                    emit(actions)
+                if checkpoint_path and state is not None:
+                    save_checkpoint(checkpoint_path, state)
+            except Exception:
+                # A transient failure (e.g. checkpoint disk full) must not
+                # silently kill straggler recovery for the server's lifetime.
+                log.exception("scheduler tick failed; will retry")
+
+    tick_thread = threading.Thread(target=ticker, daemon=True, name="sched-tick")
+    tick_thread.start()
+
+    try:
+        while True:
+            try:
+                conn_id, payload = server.read()
+            except lsp.ConnLostError as e:
+                with lock:  # stats() reads dicts the ticker may mutate
+                    log.info("connection %d lost; %s", e.conn_id, sched.stats())
+                    actions = sched.lost(e.conn_id, clock())
+                emit(actions)
+                continue
+            except lsp.ConnClosedError:
+                return
+            msg = Message.unmarshal(payload)
+            if msg is None:
+                log.warning("undecodable payload from %d", conn_id)
+                continue
+            now = clock()
+            with lock:
+                if msg.type == MsgType.JOIN:
+                    log.info("miner %d joined; %s", conn_id, sched.stats())
+                    actions = sched.miner_joined(conn_id, now)
+                elif msg.type == MsgType.REQUEST:
+                    log.info(
+                        "request from %d: data=%r range=[%d,%d]",
+                        conn_id, msg.data, msg.lower, msg.upper,
+                    )
+                    actions = sched.client_request(
+                        conn_id, msg.data, msg.lower, msg.upper, now
+                    )
+                elif msg.type == MsgType.RESULT:
+                    actions = sched.result(conn_id, msg.hash, msg.nonce, now)
+                else:
+                    actions = []
+                evicted = sched.drain_evictions()
+            emit(actions)
+            for cid in evicted:
+                log.info("closing evicted miner conn %d", cid)
+                try:
+                    server.close_conn(cid)
+                except lsp.LspError:
+                    pass  # already gone
+    finally:
+        stop.set()
+        tick_thread.join(timeout=2 * tick_interval + 1)
 
 
 def main(argv=None) -> int:
@@ -81,11 +149,19 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(filename)s:%(lineno)d %(message)s",
     )
-    if len(argv) != 2:
-        print(f"Usage: ./{argv[0]} <port>", end="")
+    # Beyond-parity flag: --checkpoint FILE persists job progress for resume.
+    checkpoint_path = None
+    pos = []
+    for a in argv[1:]:
+        if a.startswith("--checkpoint="):
+            checkpoint_path = a.split("=", 1)[1]
+        else:
+            pos.append(a)
+    if len(pos) != 1:
+        print(f"Usage: ./{argv[0]} <port> [--checkpoint=FILE]", end="")
         return 0
     try:
-        port = int(argv[1])
+        port = int(pos[0])
     except ValueError as e:
         print("Port must be a number:", e)
         return 0
@@ -95,8 +171,10 @@ def main(argv=None) -> int:
         print(str(e))
         return 0
     print("Server listening on port", port)
+    resume = load_checkpoint(checkpoint_path) if checkpoint_path else None
+    sched = Scheduler(resume_state=resume)
     try:
-        serve(server)
+        serve(server, scheduler=sched, checkpoint_path=checkpoint_path)
     finally:
         server.close()
     return 0

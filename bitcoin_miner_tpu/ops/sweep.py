@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.platform import is_tpu
 from .sha256 import DigitPos, MsgLayout, build_layout, compress, compress_rolled
 
 U32_MAX = 0xFFFFFFFF
@@ -120,7 +121,7 @@ def make_kernel_body(
     """
     n_lanes = 10**k
     if rolled is None:
-        rolled = jax.default_backend() != "tpu"
+        rolled = not is_tpu()
     comp = compress_rolled if rolled else compress
 
     def kernel(midstate, tail_const, bounds):
@@ -217,7 +218,7 @@ class SweepResult:
 
 
 def _default_backend() -> str:
-    return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return "pallas" if is_tpu() else "xla"
 
 
 def auto_tune(
@@ -302,7 +303,7 @@ def sweep_min_hash(
     (~1e9 nonces/dispatch); padding rows are skipped in-kernel.
     """
     backend, batch, max_k = auto_tune(backend, batch, max_k)
-    rolled = jax.default_backend() != "tpu"
+    rolled = not is_tpu()
 
     def get_kernel(layout, group):
         low_pos = layout.digit_pos[layout.digit_count - group.k :]

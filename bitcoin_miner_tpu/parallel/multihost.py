@@ -32,12 +32,62 @@ tests of parallel/sweep.py cover the program logic, and only the
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
+import numpy as np
 
 from .mesh import MINER_AXIS
 from jax.sharding import Mesh
+
+#: Max UTF-8-encoded job-data bytes in one broadcast buffer.  Chosen to fit
+#: an LSP datagram (MaxMessageSize=1000, lsp/util.go:16) alongside the other
+#: Request fields — data the scheduler could never have delivered anyway.
+MAX_DATA = 960
+
+_HDR = 6  # [alive, lower_hi, lower_lo, upper_hi, upper_lo, dlen]
+
+
+def encode_request(data: str, lower: int, upper: int) -> np.ndarray:
+    """Pack a Request into the fixed-shape u32 broadcast buffer.
+
+    u32 halves because the broadcast rides a jax collective (no u64 on all
+    paths).  Raises ``ValueError`` on oversize data rather than truncating:
+    a silently shortened message would mine the wrong string and return a
+    plausible-but-incorrect Result.
+    """
+    raw = data.encode("utf-8")
+    if len(raw) > MAX_DATA:
+        raise ValueError(
+            f"job data is {len(raw)} UTF-8 bytes; multihost broadcast caps "
+            f"at {MAX_DATA}"
+        )
+    if not 0 <= lower < 1 << 64 or not 0 <= upper < 1 << 64:
+        raise ValueError(f"nonce bounds out of u64 range: [{lower}, {upper}]")
+    buf = np.zeros(_HDR + MAX_DATA, dtype=np.uint32)
+    buf[0] = 1
+    buf[1], buf[2] = lower >> 32, lower & 0xFFFFFFFF
+    buf[3], buf[4] = upper >> 32, upper & 0xFFFFFFFF
+    buf[5] = len(raw)
+    buf[_HDR : _HDR + len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    return buf
+
+
+def encode_shutdown() -> np.ndarray:
+    """The all-hosts-exit sentinel (alive flag 0)."""
+    return np.zeros(_HDR + MAX_DATA, dtype=np.uint32)
+
+
+def decode_request(buf: np.ndarray) -> Optional[Tuple[str, int, int]]:
+    """Inverse of :func:`encode_request`; ``None`` means shutdown."""
+    buf = np.asarray(buf)
+    if buf[0] == 0:
+        return None
+    lower = (int(buf[1]) << 32) | int(buf[2])
+    upper = (int(buf[3]) << 32) | int(buf[4])
+    dlen = int(buf[5])
+    data = bytes(buf[_HDR : _HDR + dlen].astype(np.uint8)).decode("utf-8")
+    return data, lower, upper
 
 
 def initialize(

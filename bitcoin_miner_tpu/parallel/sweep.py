@@ -24,6 +24,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.sha256 import DigitPos
+from ..utils.platform import is_tpu_device
 from ..ops.sweep import (
     I32_MAX,
     U32_MAX,
@@ -130,10 +131,11 @@ def sweep_min_hash_sharded(
     if mesh is None:
         mesh = default_mesh(axis_name=axis_name)
     n_dev = mesh.devices.size
-    if backend is None and mesh.devices.flat[0].platform != "tpu":
+    mesh_on_tpu = is_tpu_device(mesh.devices.flat[0])
+    if backend is None and not mesh_on_tpu:
         backend = "xla"
     backend, batch_per_device, max_k = auto_tune(backend, batch_per_device, max_k)
-    rolled = mesh.devices.flat[0].platform != "tpu"
+    rolled = not mesh_on_tpu
     batch = n_dev * batch_per_device
 
     row_sharding = NamedSharding(mesh, P(axis_name, None))

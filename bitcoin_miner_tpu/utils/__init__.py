@@ -1,4 +1,10 @@
-"""Shared utilities: process-wide metrics counters and rate meters."""
+"""Shared utilities: metrics counters, rate meters, accelerator probing.
+
+``utils.platform`` is deliberately NOT re-exported here: it imports jax,
+and the pure-protocol processes (scheduler server, CPU miners) that pull
+``METRICS`` from this package must not pay — or depend on — a jax import.
+Import it directly: ``from bitcoin_miner_tpu.utils.platform import is_tpu``.
+"""
 
 from .metrics import METRICS, Metrics, RateMeter
 

@@ -44,7 +44,11 @@ class Metrics:
 #:   lsp.dropped_bad_size  datagrams rejected by Size validation
 #:   sched.chunks_assigned     chunks handed to miners
 #:   sched.chunks_reassigned   chunks returned by dead miners
+#:   sched.chunks_straggler_requeued  chunks reclaimed from hung miners
+#:   sched.results_rejected    Results that failed hashlib validation
+#:   sched.miners_evicted      miners dropped after max_rejects strikes
 #:   sched.jobs_completed      Results sent back to clients
+#:   sched.jobs_resumed        jobs resumed from a checkpoint
 #:   miner.nonces              nonces swept by this process's miner loop
 METRICS = Metrics()
 

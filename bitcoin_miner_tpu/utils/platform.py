@@ -1,0 +1,40 @@
+"""Probe-based accelerator detection.
+
+TPU plugins don't always register under the platform name ``"tpu"`` — this
+environment's PJRT plugin registers as ``"axon"`` — so a string compare
+against ``jax.default_backend()`` silently routes real TPU chips onto the
+CPU code path (rolled compression, no Pallas).  Detection therefore probes
+the device object itself: plugin platform name *and* ``device_kind``
+(which reads e.g. "TPU v5e" regardless of plugin name).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+
+# Known PJRT platform names that front real TPU hardware.
+_TPU_PLATFORMS = frozenset({"tpu", "axon"})
+
+
+def is_tpu_device(dev) -> bool:
+    """True if ``dev`` (a jax Device) is a TPU chip, whatever its plugin's
+    registered platform name."""
+    if (dev.platform or "").lower() in _TPU_PLATFORMS:
+        return True
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    return "tpu" in kind
+
+
+@lru_cache(maxsize=1)
+def is_tpu() -> bool:
+    """True if the default JAX backend fronts TPU hardware (initializes the
+    backend on first call; cached per process)."""
+    return is_tpu_device(jax.devices()[0])
+
+
+def device_desc(dev) -> str:
+    """Human-readable one-liner for logs: platform + device_kind."""
+    kind = getattr(dev, "device_kind", None) or "?"
+    return f"{dev.platform}:{kind}"

@@ -27,7 +27,7 @@ def test_rate_meter():
 
 def test_scheduler_counters():
     base = METRICS.snapshot()
-    s = Scheduler(min_chunk=100)
+    s = Scheduler(validate_results=False, min_chunk=100)
     s.miner_joined(1)
     s.client_request(10, "d", 0, 99)
     s.lost(1)          # chunk goes back to pending

@@ -1,0 +1,145 @@
+"""Multi-host logical-miner protocol: broadcast codec + primary/secondary
+serve loop (apps/miner.serve_multihost, parallel/multihost.py).
+
+The real path needs N processes + jax.distributed; these tests cover the
+untested-in-round-1 logic — the hand-rolled u32 broadcast buffer codec and
+the lockstep Request loop — with a faked broadcast collective, per the
+single-logical-miner contract in parallel/multihost.py.
+"""
+
+import numpy as np
+import pytest
+
+from bitcoin_miner_tpu import lsp
+from bitcoin_miner_tpu.apps.miner import serve_multihost
+from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+from bitcoin_miner_tpu.bitcoin.message import Message, MsgType
+from bitcoin_miner_tpu.parallel.multihost import (
+    MAX_DATA,
+    decode_request,
+    encode_request,
+    encode_shutdown,
+)
+
+
+class TestCodec:
+    @pytest.mark.parametrize(
+        "data,lo,hi",
+        [
+            ("cmu440", 0, 10**6),
+            ("", 5, 5),
+            ("héllo wörld ⚡", 0, (1 << 64) - 1),  # multi-byte UTF-8
+            ("x" * MAX_DATA, 123, 456),  # exactly at the cap
+        ],
+    )
+    def test_round_trip(self, data, lo, hi):
+        assert decode_request(encode_request(data, lo, hi)) == (data, lo, hi)
+
+    def test_shutdown_decodes_none(self):
+        assert decode_request(encode_shutdown()) is None
+
+    def test_oversize_data_rejected_not_truncated(self):
+        with pytest.raises(ValueError, match="caps at"):
+            encode_request("x" * (MAX_DATA + 1), 0, 1)
+
+    def test_oversize_multibyte_rejected(self):
+        # 481 three-byte chars = 1443 encoded bytes; a byte-slice truncation
+        # would have split a sequence and crashed the strict decode.
+        with pytest.raises(ValueError, match="caps at"):
+            encode_request("⚡" * 481, 0, 1)
+
+    def test_u64_bounds_enforced(self):
+        with pytest.raises(ValueError, match="u64"):
+            encode_request("d", 0, 1 << 64)
+        with pytest.raises(ValueError, match="u64"):
+            encode_request("d", -1, 1)
+
+    def test_buffer_is_fixed_shape_u32(self):
+        a, b = encode_request("abc", 0, 1), encode_shutdown()
+        assert a.shape == b.shape and a.dtype == b.dtype == np.uint32
+
+
+class FakeClient:
+    """Scripted LSP client: read() pops payloads, then raises ConnLostError."""
+
+    def __init__(self, payloads):
+        self._payloads = list(payloads)
+        self.written = []
+
+    def read(self):
+        if not self._payloads:
+            raise lsp.ConnLostError(0)
+        return self._payloads.pop(0)
+
+    def write(self, payload):
+        self.written.append(Message.unmarshal(payload))
+
+
+def sweep_oracle(data, lo, hi):
+    return min_hash_range(data, lo, hi)
+
+
+class TestServeLoop:
+    def test_primary_request_sweep_result(self):
+        client = FakeClient(
+            [
+                Message.request("cmu440", 0, 99).marshal(),
+                Message.request("cmu440", 100, 199).marshal(),
+            ]
+        )
+        sent = []
+
+        def broadcast(buf):
+            sent.append(np.array(buf))
+            return buf
+
+        serve_multihost(client, sweep_oracle, broadcast)
+        # Two Results, bit-exact, then the conn-loss shutdown broadcast.
+        assert [(m.hash, m.nonce) for m in client.written] == [
+            min_hash_range("cmu440", 0, 99),
+            min_hash_range("cmu440", 100, 199),
+        ]
+        assert len(sent) == 3
+        assert decode_request(sent[0]) == ("cmu440", 0, 99)
+        assert decode_request(sent[2]) is None  # shutdown fans out
+
+    def test_secondary_executes_broadcasts_in_lockstep(self):
+        script = [
+            encode_request("jobdata", 50, 60),
+            encode_request("jobdata", 61, 70),
+            encode_shutdown(),
+        ]
+        swept = []
+
+        def sweep(data, lo, hi):
+            swept.append((data, lo, hi))
+            return min_hash_range(data, lo, hi)
+
+        serve_multihost(None, sweep, lambda _buf: script.pop(0))
+        assert swept == [("jobdata", 50, 60), ("jobdata", 61, 70)]
+
+    def test_primary_skips_non_request_messages(self):
+        client = FakeClient(
+            [
+                Message.join().marshal(),  # stray Join echoes are ignored
+                Message.result(1, 2).marshal(),
+                Message.request("d", 0, 9).marshal(),
+            ]
+        )
+        serve_multihost(client, sweep_oracle, lambda b: b)
+        assert [(m.hash, m.nonce) for m in client.written] == [
+            min_hash_range("d", 0, 9)
+        ]
+
+    def test_oversize_request_shuts_down_loudly(self, capsys):
+        client = FakeClient([Message.request("y" * 2000, 0, 9).marshal()])
+        sent = []
+
+        def broadcast(buf):
+            sent.append(np.array(buf))
+            return buf
+
+        serve_multihost(client, sweep_oracle, broadcast)
+        assert client.written == []  # no plausible-but-wrong Result
+        assert len(sent) == 1 and decode_request(sent[0]) is None
+        assert "rejecting request" in capsys.readouterr().err

@@ -1,0 +1,44 @@
+"""Probe-based TPU detection (utils/platform.py).
+
+Guards against the round-1 hazard: this environment's TPU plugin registers
+as platform 'axon', so a ``jax.default_backend() == "tpu"`` string compare
+silently routes real TPU chips onto the CPU tier.
+"""
+
+from types import SimpleNamespace
+
+from bitcoin_miner_tpu.utils.platform import device_desc, is_tpu, is_tpu_device
+
+
+def dev(platform, kind=""):
+    return SimpleNamespace(platform=platform, device_kind=kind)
+
+
+def test_canonical_tpu_platform():
+    assert is_tpu_device(dev("tpu", "TPU v5e"))
+    assert is_tpu_device(dev("TPU"))
+
+
+def test_axon_plugin_name_is_tpu():
+    assert is_tpu_device(dev("axon", "TPU v5 lite"))
+    assert is_tpu_device(dev("axon"))  # even with no device_kind
+
+
+def test_unknown_plugin_detected_via_device_kind():
+    assert is_tpu_device(dev("someplugin", "TPU v6e"))
+
+
+def test_cpu_and_gpu_are_not_tpu():
+    assert not is_tpu_device(dev("cpu", "cpu"))
+    assert not is_tpu_device(dev("cuda", "NVIDIA H100"))
+    assert not is_tpu_device(dev("cpu", None))
+
+
+def test_is_tpu_under_forced_cpu_platform():
+    # conftest forces the virtual-CPU platform for the whole test process.
+    assert is_tpu() is False
+
+
+def test_device_desc():
+    assert device_desc(dev("axon", "TPU v5e")) == "axon:TPU v5e"
+    assert device_desc(dev("cpu", None)) == "cpu:?"

@@ -16,7 +16,7 @@ def drain(job_actions):
 
 class TestBasicFlow:
     def test_join_then_request_assigns(self):
-        s = Scheduler(min_chunk=100)
+        s = Scheduler(validate_results=False, min_chunk=100)
         assert s.miner_joined(1) == []
         actions = s.client_request(10, "data", 0, 99)
         assert len(actions) == 1
@@ -26,14 +26,14 @@ class TestBasicFlow:
         assert (msg.lower, msg.upper) == (0, 99)
 
     def test_request_then_join_assigns(self):
-        s = Scheduler(min_chunk=100)
+        s = Scheduler(validate_results=False, min_chunk=100)
         assert s.client_request(10, "data", 0, 99) == []
         actions = s.miner_joined(1)
         assert len(actions) == 1
         assert actions[0][0] == 1
 
     def test_result_completes_job(self):
-        s = Scheduler(min_chunk=1000)
+        s = Scheduler(validate_results=False, min_chunk=1000)
         s.miner_joined(1)
         s.client_request(10, "data", 0, 99)
         actions = s.result(1, hash_=555, nonce=42)
@@ -45,7 +45,7 @@ class TestBasicFlow:
         assert s.miners[1].job is None  # miner idle again
 
     def test_range_split_across_miners_min_folds(self):
-        s = Scheduler(min_chunk=50)
+        s = Scheduler(validate_results=False, min_chunk=50)
         for m in (1, 2):
             s.miner_joined(m)
         actions = s.client_request(10, "data", 0, 99)
@@ -58,7 +58,7 @@ class TestBasicFlow:
         assert final[0][1].hash == 300 and final[0][1].nonce == 61
 
     def test_tie_break_lowest_nonce(self):
-        s = Scheduler(min_chunk=50)
+        s = Scheduler(validate_results=False, min_chunk=50)
         s.miner_joined(1)
         s.miner_joined(2)
         s.client_request(10, "d", 0, 99)
@@ -67,7 +67,7 @@ class TestBasicFlow:
         assert final[0][1].nonce == 3
 
     def test_empty_range_answers_immediately(self):
-        s = Scheduler()
+        s = Scheduler(validate_results=False)
         actions = s.client_request(10, "d", 5, 4)
         assert actions[0][0] == 10
         assert actions[0][1].type == MsgType.RESULT
@@ -75,7 +75,7 @@ class TestBasicFlow:
 
 class TestFaults:
     def test_dead_miner_chunk_reassigned(self):
-        s = Scheduler(min_chunk=1000)
+        s = Scheduler(validate_results=False, min_chunk=1000)
         s.miner_joined(1)
         s.client_request(10, "d", 0, 499)
         actions = s.lost(1)  # miner dies mid-chunk
@@ -85,7 +85,7 @@ class TestFaults:
         assert (actions[0][1].lower, actions[0][1].upper) == (0, 499)
 
     def test_dead_miner_with_idle_peer_reassigns_immediately(self):
-        s = Scheduler(min_chunk=1000)
+        s = Scheduler(validate_results=False, min_chunk=1000)
         s.miner_joined(1)
         s.miner_joined(2)
         s.client_request(10, "d", 0, 499)  # one chunk -> one miner busy
@@ -95,7 +95,7 @@ class TestFaults:
         assert (actions[0][1].lower, actions[0][1].upper) == (0, 499)
 
     def test_dead_client_drops_job_and_result_ignored(self):
-        s = Scheduler(min_chunk=1000)
+        s = Scheduler(validate_results=False, min_chunk=1000)
         s.miner_joined(1)
         s.client_request(10, "d", 0, 499)
         assert s.lost(10) == []  # client dies: job cancelled silently
@@ -105,7 +105,7 @@ class TestFaults:
         assert s.miners[1].job is None
 
     def test_miner_death_preserves_low_nonce_order(self):
-        s = Scheduler(min_chunk=100, max_chunk=100)
+        s = Scheduler(validate_results=False, min_chunk=100, max_chunk=100)
         s.miner_joined(1)
         s.client_request(10, "d", 0, 299)  # miner 1 gets [0,99]
         s.lost(1)
@@ -115,7 +115,7 @@ class TestFaults:
 
 class TestAdaptiveChunking:
     def test_fast_miner_gets_bigger_chunks(self):
-        s = Scheduler(min_chunk=100, max_chunk=10**9, target_chunk_seconds=1.0)
+        s = Scheduler(validate_results=False, min_chunk=100, max_chunk=10**9, target_chunk_seconds=1.0)
         s.miner_joined(1, now=0.0)
         s.client_request(10, "d", 0, 10**9, now=0.0)
         # first chunk is min_chunk (rate unknown)
@@ -128,7 +128,7 @@ class TestAdaptiveChunking:
         assert 50_000 <= size <= 200_000
 
     def test_chunk_capped_at_max(self):
-        s = Scheduler(min_chunk=10, max_chunk=1000, target_chunk_seconds=1.0)
+        s = Scheduler(validate_results=False, min_chunk=10, max_chunk=1000, target_chunk_seconds=1.0)
         s.miner_joined(1, now=0.0)
         s.client_request(10, "d", 0, 10**9, now=0.0)
         actions = s.result(1, hash_=7, nonce=0, now=1e-9)  # absurd rate
@@ -138,7 +138,7 @@ class TestAdaptiveChunking:
 
 class TestFairness:
     def test_round_robin_across_jobs(self):
-        s = Scheduler(min_chunk=10, max_chunk=10)
+        s = Scheduler(validate_results=False, min_chunk=10, max_chunk=10)
         s.client_request(10, "a", 0, 99)
         s.client_request(11, "b", 0, 99)
         served = []
@@ -148,19 +148,19 @@ class TestFairness:
         assert served.count("a") == 2 and served.count("b") == 2
 
     def test_duplicate_join_ignored(self):
-        s = Scheduler()
+        s = Scheduler(validate_results=False)
         s.miner_joined(1)
         assert s.miner_joined(1) == []
         assert len(s.miners) == 1
 
     def test_second_request_on_same_conn_ignored(self):
-        s = Scheduler(min_chunk=10**6)
+        s = Scheduler(validate_results=False, min_chunk=10**6)
         s.miner_joined(1)
         s.client_request(10, "a", 0, 9)
         assert s.client_request(10, "b", 0, 9) == []
 
     def test_stats(self):
-        s = Scheduler(min_chunk=10, max_chunk=10)
+        s = Scheduler(validate_results=False, min_chunk=10, max_chunk=10)
         s.miner_joined(1)
         s.client_request(10, "a", 0, 99)
         st = s.stats()
