@@ -42,17 +42,17 @@ def _build() -> Optional[Path]:
     # Per-process tmp: concurrent first-use builders must not share a tmp
     # path, or one process can promote another's half-written object.
     tmp = out.with_suffix(f".so.tmp.{os.getpid()}")
-    base = ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)]
-    # SHA-NI build first (runtime-dispatched, so safe to *build* anywhere the
-    # flags are accepted); plain build as the portable fallback.
-    for extra in (["-msha", "-msse4.1", "-mssse3"], []):
-        try:
-            subprocess.run(base + extra, check=True, capture_output=True, timeout=120)
-        except (OSError, subprocess.SubprocessError):
-            continue
-        os.replace(tmp, out)  # atomic: concurrent builders race benignly
-        return out
-    return None
+    # One portable build: the SHA-NI compression is gated per-function in
+    # the source (__attribute__((target(...))) + __builtin_cpu_supports), so
+    # no TU-wide ISA flags — everything outside compress_shani stays
+    # baseline x86-64 and the .so is safe on any CPU.
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    return out
 
 
 def _load() -> Optional[ctypes.CDLL]:

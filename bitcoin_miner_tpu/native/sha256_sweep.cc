@@ -18,7 +18,11 @@
 #include <cstdint>
 #include <cstring>
 
-#if defined(__x86_64__) && defined(__SHA__)
+// The SHA-NI path is gated per-function with a target attribute (not
+// TU-wide -msha flags): the rest of the object must stay baseline x86-64,
+// or the compiler could auto-vectorize the portable code with SSE4.1+ and
+// SIGILL on older CPUs despite the runtime dispatch of compress_shani.
+#if defined(__x86_64__) && (defined(__GNUC__) || defined(__clang__))
 #include <immintrin.h>
 #define HAVE_SHANI_BUILD 1
 #endif
@@ -79,6 +83,7 @@ void compress(uint32_t st[8], const uint8_t *block) {
 // ABEF/CDGH register pairing the sha256rnds2 instruction expects; message
 // blocks are produced by the msg1/msg2 schedule helpers over a rotating
 // 4-register window of W[t-16..t-1].
+__attribute__((target("sha,sse4.1,ssse3")))
 void compress_shani(uint32_t st[8], const uint8_t *block) {
   const __m128i MASK =
       _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);

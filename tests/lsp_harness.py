@@ -37,6 +37,8 @@ class TestSystem:
     epoch_limit: int = 5
     max_epochs: int = 60  # global deadline, in epochs
     write_drop: int = 0  # symmetric write-drop percent while echoing
+    sleep_max_ms: int = 0  # random client+server delays (setMaxSleepMillis,
+    # lsp1_test.go TestBasic7-9 / TestSendReceive3)
     desc: str = ""
 
     errors: List[str] = field(default_factory=list)
@@ -60,10 +62,17 @@ class TestSystem:
         server = lsp.Server(0, self.params)
         stop = threading.Event()
 
+        def maybe_sleep() -> None:
+            if self.sleep_max_ms:
+                import time
+
+                time.sleep(random.uniform(0, self.sleep_max_ms) / 1000.0)
+
         def server_loop() -> None:
             while not stop.is_set():
                 try:
                     cid, payload = server.read()
+                    maybe_sleep()
                     server.write(cid, payload)
                 except lsp.ConnLostError:
                     continue
@@ -85,6 +94,7 @@ class TestSystem:
             try:
                 for i in range(self.num_msgs):
                     value = f"{idx}:{i}:{random.randint(0, 1_000_000)}".encode()
+                    maybe_sleep()
                     c.write(value)
                     got = c.read()
                     if got != value:

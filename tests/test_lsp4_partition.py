@@ -150,6 +150,84 @@ def test_client_fast_close_flushes_after_heal():
     server.close()
 
 
+def _server_fast_close(n_clients: int, n_msgs: int) -> None:
+    """Server writes to every client during a partition, then calls Close
+    while the network is still down; Close must block until the heal lets
+    everything drain, and every client must receive its full stream in
+    order (lsp4_test.go:444-463 TestServerFastClose1-3)."""
+    p = params()
+    server = lsp.Server(0, p)
+    clients = []
+    got = {}
+
+    def reader(idx, c):
+        while True:
+            try:
+                got[idx].append(c.read())
+            except lsp.LspError:
+                return
+
+    for idx in range(n_clients):
+        c = lsp.Client("127.0.0.1", server.port, p)
+        clients.append(c)
+        got[idx] = []
+        c.write(b"warm%d" % idx)
+
+    # Learn each conn's id from its warm-up message.
+    cid_by_idx = {}
+    for _ in range(n_clients):
+        cid, payload = server.read()
+        cid_by_idx[int(payload[4:])] = cid
+    readers = [spawn(lambda i=i, c=c: reader(i, c)) for i, c in enumerate(clients)]
+
+    partition(True)
+    want = [b"s%d" % i for i in range(n_msgs)]
+    for idx in range(n_clients):
+        for m in want:
+            server.write(cid_by_idx[idx], m)
+
+    close_done = []
+
+    def closer():
+        server.close()
+        close_done.append(time.time())
+
+    t = spawn(closer)
+    time.sleep(3 * EPOCH_MS / 1000)
+    assert not close_done, "server Close returned during the partition"
+    for g in got.values():
+        assert g == [], "data leaked through the partition"
+    partition(False)
+    t.join(timeout=100 * EPOCH_MS / 1000)
+    assert close_done, "server Close never completed after heal"
+
+    deadline = time.time() + 20
+    while any(len(g) < n_msgs for g in got.values()) and time.time() < deadline:
+        time.sleep(0.02)
+    for idx in range(n_clients):
+        assert got[idx] == want, f"client {idx} stream wrong"
+    for c in clients:
+        try:
+            c.close()
+        except lsp.LspError:
+            pass
+    for r in readers:
+        r.join(timeout=5)
+
+
+def test_server_fast_close_single_client():
+    _server_fast_close(1, 10)
+
+
+def test_server_fast_close_three_clients():
+    _server_fast_close(3, 10)
+
+
+def test_server_fast_close_five_clients_bulk():
+    # TestServerFastClose3 scale: 5 clients x 500 messages.
+    _server_fast_close(5, 500)
+
+
 def test_round_trip_across_partitions():
     """Echo traffic while the network flaps (lsp4_test.go:507-526)."""
     p = params()

@@ -193,6 +193,102 @@ def test_mining_under_packet_loss():
         sys_.close()
 
 
+def test_xla_backend_fleet():
+    """The Request→sweep→Result glue through the JAX tier — the backend a
+    real TPU miner runs (here on the virtual CPU mesh).  Round 1 only ever
+    exercised the fleet with the cpu oracle; this pins the apps/miner.py
+    routing of Request fields into sweep_min_hash."""
+    sys_ = MiningSystem(n_miners=0, min_chunk=400)
+    try:
+        sys_.add_miner(miner_mod.make_search("xla"))
+        sys_.add_miner()  # heterogeneous: xla + cpu oracle in one fleet
+        res = sys_.request("xlatier", 2500)
+        assert res == min_hash_range("xlatier", 0, 2500)
+    finally:
+        sys_.close()
+
+
+def test_checkpoint_resume_fleet_restart(tmp_path):
+    """Kill the whole fleet mid-job; a restarted server resumes from the
+    checkpoint file and completes WITHOUT re-sweeping finished sub-ranges
+    (scheduler checkpoint/resume, SURVEY §5 beyond-parity)."""
+    ckpt = str(tmp_path / "ckpt.json")
+    data, mx = "resumable", 9999
+    first_done = threading.Event()
+    hold = threading.Event()
+
+    def first_then_hang(d, lo, hi):
+        r = min_hash_range(d, lo, hi)
+        if first_done.is_set():
+            hold.wait(timeout=30)  # freeze the fleet after one chunk lands
+        first_done.set()
+        return r
+
+    # --- fleet 1: completes exactly one chunk, then is killed ------------
+    server1 = lsp.Server(0, PARAMS)
+    sched1 = Scheduler(min_chunk=2000, straggler_min_seconds=60.0)
+    t1 = threading.Thread(
+        target=server_mod.serve,
+        args=(server1, sched1),
+        kwargs={"tick_interval": 0.05, "checkpoint_path": ckpt},
+        daemon=True,
+    )
+    t1.start()
+    m1 = lsp.Client("127.0.0.1", server1.port, PARAMS)
+    threading.Thread(
+        target=miner_mod.run_miner, args=(m1, first_then_hang), daemon=True
+    ).start()
+    c1 = lsp.Client("127.0.0.1", server1.port, PARAMS)
+    c1.write(Message.request(data, 0, mx).marshal())
+    assert first_done.wait(timeout=30), "first chunk never completed"
+    # Wait for a checkpoint that has folded the first chunk's result.
+    deadline = time.time() + 10
+    state = None
+    while time.time() < deadline:
+        state = server_mod.load_checkpoint(ckpt)
+        if state and state["jobs"] and state["jobs"][0]["best"] is not None:
+            break
+        time.sleep(0.05)
+    assert state and state["jobs"][0]["best"] is not None, "no checkpoint"
+    server1.close()  # fleet dies mid-job
+    hold.set()
+
+    # --- fleet 2: resumes from the file -----------------------------------
+    [jobdict] = state["jobs"]
+    completed_upper = min(lo for lo, _ in jobdict["remaining"]) - 1
+    assert completed_upper >= 0, "nothing was actually completed"
+
+    swept = []
+
+    def recording_search(d, lo, hi):
+        swept.append((lo, hi))
+        return min_hash_range(d, lo, hi)
+
+    server2 = lsp.Server(0, PARAMS)
+    sched2 = Scheduler(
+        min_chunk=2000, resume_state=server_mod.load_checkpoint(ckpt)
+    )
+    threading.Thread(
+        target=server_mod.serve, args=(server2, sched2), daemon=True
+    ).start()
+    m2 = lsp.Client("127.0.0.1", server2.port, PARAMS)
+    threading.Thread(
+        target=miner_mod.run_miner, args=(m2, recording_search), daemon=True
+    ).start()
+    try:
+        c2 = lsp.Client("127.0.0.1", server2.port, PARAMS)
+        try:
+            res = client_mod.request_once(c2, data, mx)
+        finally:
+            c2.close()
+        assert res == min_hash_range(data, 0, mx)
+        assert swept, "resumed fleet did no work"
+        # Nothing below the completed prefix may have been re-swept.
+        assert min(lo for lo, _ in swept) > completed_upper
+    finally:
+        server2.close()
+
+
 def test_client_disconnected_output():
     """Frozen stdout contract: server dies -> client prints Disconnected."""
     import io
