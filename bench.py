@@ -116,6 +116,7 @@ def main() -> int:
         # sitecustomize imports jax at boot with the TPU plugin selected).
         jax.config.update("jax_platforms", "cpu")
 
+    from bitcoin_miner_tpu import native
     from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
     from bitcoin_miner_tpu.ops.sweep import sweep_min_hash
     from bitcoin_miner_tpu.utils.platform import device_desc, is_tpu
@@ -123,35 +124,49 @@ def main() -> int:
     dev = jax.devices()[0]
     platform = dev.platform
     device_kind = getattr(dev, "device_kind", "") or ""
-    backend = "pallas" if is_tpu() else "xla"
+    if is_tpu():
+        backend = "pallas"
+    elif native.available():
+        # Best CPU tier: the compiled multi-threaded SHA-NI sweep (what a
+        # real --backend cpu miner runs), not the jnp-on-CPU path.
+        backend = "native"
+    else:
+        backend = "xla"
     log(
         f"platform={platform} device={device_desc(dev)} "
         f"devices={len(jax.devices())} backend={backend}"
     )
 
+    def run(d: str, lo: int, hi: int, max_k=None):
+        if backend == "native":
+            h, n = native.min_hash_range_native(d, lo, hi)
+            return h, n, hi - lo + 1
+        r = sweep_min_hash(d, lo, hi, backend=backend, max_k=max_k)
+        return r.hash, r.nonce, r.lanes_swept
+
     # -- correctness gate ---------------------------------------------------
     data = "cmu440"
     lo, hi = 95, 1205  # crosses 2->3->4 digit boundaries
     try:
-        r = sweep_min_hash(data, lo, hi, backend=backend, max_k=2)
+        h, n, _ = run(data, lo, hi, max_k=2)
     except Exception as e:  # pallas tier unavailable -> fall back, still bench
         log(f"{backend} tier failed ({e!r}); falling back to xla")
         backend = "xla"
-        r = sweep_min_hash(data, lo, hi, backend=backend, max_k=2)
+        h, n, _ = run(data, lo, hi, max_k=2)
     expect = min_hash_range(data, lo, hi)
-    if (r.hash, r.nonce) != expect:
-        log(f"CORRECTNESS FAILURE: kernel {(r.hash, r.nonce)} oracle {expect}")
+    if (h, n) != expect:
+        log(f"CORRECTNESS FAILURE: kernel {(h, n)} oracle {expect}")
         emit(
             {
                 "error": "correctness gate failed",
-                "kernel": [r.hash, r.nonce],
+                "kernel": [h, n],
                 "oracle": list(expect),
                 "platform": platform,
                 "backend": backend,
             }
         )
         return 1
-    log(f"correctness OK: hash={r.hash} nonce={r.nonce}")
+    log(f"correctness OK: hash={h} nonce={n}")
 
     # -- throughput ---------------------------------------------------------
     # Steady-state rate on one digit bucket (d=10): warm up the exact shape
@@ -161,9 +176,9 @@ def main() -> int:
 
     def timed(n: int) -> float:
         t0 = time.perf_counter()
-        res = sweep_min_hash(data, base, base + n - 1, backend=backend)
+        _h, _n, swept = run(data, base, base + n - 1)
         dt = time.perf_counter() - t0
-        assert res.lanes_swept == n
+        assert swept == n
         return dt
 
     warm = 10**6

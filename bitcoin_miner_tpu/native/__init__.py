@@ -46,7 +46,7 @@ def _build() -> Optional[Path]:
     # the source (__attribute__((target(...))) + __builtin_cpu_supports), so
     # no TU-wide ISA flags — everything outside compress_shani stays
     # baseline x86-64 and the .so is safe on any CPU.
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", str(tmp), str(_SRC)]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (OSError, subprocess.SubprocessError):
@@ -80,6 +80,16 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.sha256_sweep_min.restype = None
+        lib.sha256_sweep_min_mt.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.sha256_sweep_min_mt.restype = None
         _lib = lib
         return _lib
 
@@ -88,21 +98,27 @@ def available() -> bool:
     return _load() is not None
 
 
-def min_hash_range_native(msg: str, lower: int, upper: int) -> Tuple[int, int]:
+def min_hash_range_native(
+    msg: str, lower: int, upper: int, threads: int = 0
+) -> Tuple[int, int]:
     """Compiled scan of inclusive [lower, upper]; bit-exact vs the hashlib
-    oracle, lowest-nonce ties.  Raises RuntimeError if the native tier is
-    unavailable (callers check :func:`available` to fall back)."""
+    oracle, lowest-nonce ties.  ``threads``: 0 = all hardware cores (the
+    sweep splits into contiguous per-thread sub-ranges and min-reduces), 1 =
+    the single-threaded scalar loop.  Raises RuntimeError if the native
+    tier is unavailable (callers check :func:`available` to fall back)."""
     if lower > upper:
         raise ValueError(f"empty nonce range [{lower}, {upper}]")
     if lower < 0 or upper >= 1 << 64:
         raise ValueError(f"nonce range out of uint64: [{lower}, {upper}]")
+    if threads < 0:
+        raise ValueError(f"threads must be >= 0, got {threads}")
     lib = _load()
     if lib is None:
         raise RuntimeError("native sha256 sweep unavailable (no compiler?)")
     h = ctypes.c_uint64()
     n = ctypes.c_uint64()
     data = msg.encode("utf-8")
-    lib.sha256_sweep_min(
-        data, len(data), lower, upper, ctypes.byref(h), ctypes.byref(n)
+    lib.sha256_sweep_min_mt(
+        data, len(data), lower, upper, threads, ctypes.byref(h), ctypes.byref(n)
     )
     return h.value, n.value

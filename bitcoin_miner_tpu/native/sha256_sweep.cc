@@ -17,6 +17,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 // The SHA-NI path is gated per-function with a target attribute (not
 // TU-wide -msha flags): the rest of the object must stay baseline x86-64,
@@ -247,6 +249,45 @@ uint64_t sha256_hash_one(const uint8_t *data, uint64_t data_len,
   uint64_t h, n;
   sha256_sweep_min(data, data_len, nonce, nonce, &h, &n);
   return h;
+}
+
+// Multi-threaded sweep: contiguous sub-ranges per thread, (hash, nonce)
+// lexicographic reduce — bit-exact with the scalar sweep incl. the
+// lowest-nonce tie-break, since each thread already returns its lowest
+// nonce and sub-ranges ascend.  nthreads == 0 means hardware concurrency.
+void sha256_sweep_min_mt(const uint8_t *data, uint64_t data_len,
+                         uint64_t lower, uint64_t upper, uint32_t nthreads,
+                         uint64_t *out_hash, uint64_t *out_nonce) {
+  uint64_t span = upper - lower + 1;  // callers guarantee lower <= upper
+  uint64_t t = nthreads ? nthreads : std::thread::hardware_concurrency();
+  if (t < 1) t = 1;
+  if (t > span) t = span;
+  if (t == 1) {
+    sha256_sweep_min(data, data_len, lower, upper, out_hash, out_nonce);
+    return;
+  }
+  std::vector<uint64_t> hashes(t), nonces(t);
+  std::vector<std::thread> workers;
+  workers.reserve(t);
+  uint64_t chunk = span / t, rem = span % t, lo = lower;
+  for (uint64_t i = 0; i < t; ++i) {
+    uint64_t hi = lo + chunk - 1 + (i < rem ? 1 : 0);
+    workers.emplace_back([=, &hashes, &nonces] {
+      sha256_sweep_min(data, data_len, lo, hi, &hashes[i], &nonces[i]);
+    });
+    lo = hi + 1;
+  }
+  uint64_t best_hash = 0, best_nonce = 0;
+  for (uint64_t i = 0; i < t; ++i) {
+    workers[i].join();
+    if (i == 0 || hashes[i] < best_hash ||
+        (hashes[i] == best_hash && nonces[i] < best_nonce)) {
+      best_hash = hashes[i];
+      best_nonce = nonces[i];
+    }
+  }
+  *out_hash = best_hash;
+  *out_nonce = best_nonce;
 }
 
 }  // extern "C"

@@ -40,6 +40,35 @@ def test_rollover_99999(it=99_990):
     )
 
 
+@pytest.mark.parametrize("threads", [1, 2, 3, 8])
+def test_multithreaded_matches_single(threads):
+    data, lo, hi = "mtsweep", 50, 4321  # crosses digit boundaries
+    want = min_hash_range(data, lo, hi)
+    assert native.min_hash_range_native(data, lo, hi, threads=threads) == want
+
+
+def test_multithreaded_more_threads_than_range():
+    # span 3 with 8 threads: clamps to one nonce per thread
+    data, lo, hi = "tiny", 7, 9
+    want = min_hash_range(data, lo, hi)
+    assert native.min_hash_range_native(data, lo, hi, threads=8) == want
+
+
+def test_multithreaded_tie_break_lowest_nonce():
+    # A single-nonce "range" duplicated across threads can't tie, so force
+    # the reduce path with hardware default threads on a real range and
+    # cross-check the scalar path's lowest-nonce answer.
+    data, lo, hi = "tie", 0, 2000
+    assert native.min_hash_range_native(
+        data, lo, hi, threads=0
+    ) == native.min_hash_range_native(data, lo, hi, threads=1)
+
+
+def test_negative_threads_raises():
+    with pytest.raises(ValueError):
+        native.min_hash_range_native("x", 0, 10, threads=-1)
+
+
 def test_empty_range_raises():
     with pytest.raises(ValueError):
         native.min_hash_range_native("x", 10, 9)
