@@ -40,12 +40,20 @@ def make_search(backend: str = "auto", devices: Optional[int] = None) -> SearchF
             )
         from .. import native
 
-        # Compiled C++ sweep (SHA-NI when the CPU has it) — the analogue of
-        # the Go reference riding stdlib assembly SHA-256; hashlib fallback.
+        # Compiled C++ sweep (SHA-NI when the CPU has it, all cores) — the
+        # analogue of the Go reference riding stdlib assembly SHA-256;
+        # hashlib fallback.
         if native.available():
             return native.min_hash_range_native
         return min_hash_range
     if backend == "auto":
+        if devices in (None, 1):
+            # Best single-device tier: pallas on TPU; on a CPU-only host the
+            # compiled multi-core sweep beats jnp-on-CPU by ~25x.
+            from ..utils.platform import is_tpu
+
+            if not is_tpu():
+                return make_search("cpu")
         backend = None  # let the ops layer pick pallas-on-TPU / xla elsewhere
     if devices is not None and devices != 1:
         if devices < 1:

@@ -1,0 +1,155 @@
+"""Randomized ConnCore property test: exactly-once in-order delivery under
+adversarial drop / reorder / duplication.
+
+The pytest LSP suites mirror the reference's *scenarios*; this goes beyond
+them (SURVEY §4 has no counterpart — the reference can't unit-test its
+transport core, ours is sans-IO): two ConnCores wired through a seeded
+chaos channel that drops, reorders, duplicates and stalls packets, with
+epochs fired at random.  Whatever the interleaving, every written payload
+must arrive exactly once, in order, on the peer — and the cores must drain
+once the channel is allowed to deliver.
+"""
+
+import random
+
+import pytest
+
+from bitcoin_miner_tpu.lsp.conn import ConnCore
+from bitcoin_miner_tpu.lsp.message import Message, MsgType
+from bitcoin_miner_tpu.lsp.params import Params
+
+
+class ChaosChannel:
+    """Holds in-flight packets; delivery order/fate driven by the test rng."""
+
+    def __init__(self, rng):
+        self.rng = rng
+        self.in_flight = []  # (dst, Message)
+
+    def send_to(self, dst):
+        def send(msg):
+            self.in_flight.append((dst, msg))
+
+        return send
+
+    def step(self, drop_p, dup_p):
+        """Deliver one randomly-chosen packet (reorder by construction),
+        possibly dropping or duplicating it.  Returns False if empty."""
+        if not self.in_flight:
+            return False
+        i = self.rng.randrange(len(self.in_flight))
+        dst, msg = self.in_flight.pop(i)
+        r = self.rng.random()
+        if r < drop_p:
+            return True  # eaten by the network
+        if r < drop_p + dup_p:
+            self.in_flight.append((dst, msg))  # duplicate stays in flight
+        dst.heard_from_peer()
+        if msg.type == MsgType.DATA:
+            dst.on_data(msg)
+        elif msg.type == MsgType.ACK:
+            dst.on_ack(msg.seq_num)
+        return True
+
+
+def wire_pair(rng, window):
+    # Generous epoch limit: the fuzz stalls the channel arbitrarily long and
+    # loss declaration is not under test here.
+    params = Params(epoch_limit=10**9, epoch_millis=1, window_size=window)
+    chan = ChaosChannel(rng)
+    delivered = {"a": [], "b": []}
+    a = ConnCore(1, params, send_fn=None, deliver_fn=delivered["a"].append)
+    b = ConnCore(1, params, send_fn=None, deliver_fn=delivered["b"].append)
+    a._send = chan.send_to(b)
+    b._send = chan.send_to(a)
+    return chan, a, b, delivered
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 7, 42, 99, 1234, 31337])
+def test_exactly_once_in_order_under_chaos(seed):
+    rng = random.Random(seed)
+    window = rng.choice([1, 2, 5, 32])
+    n_msgs = rng.randint(20, 120)
+    chan, a, b, delivered = wire_pair(rng, window)
+
+    sent = {"a": [], "b": []}
+    pending_writes = {"a": n_msgs, "b": n_msgs}
+    cores = {"a": a, "b": b}
+    other = {"a": "b", "b": "a"}
+
+    steps = 0
+    while (
+        pending_writes["a"]
+        or pending_writes["b"]
+        or len(delivered["a"]) < n_msgs
+        or len(delivered["b"]) < n_msgs
+    ):
+        steps += 1
+        assert steps < 200_000, (
+            f"no convergence (seed={seed}): delivered "
+            f"{len(delivered['a'])}/{len(delivered['b'])} of {n_msgs}"
+        )
+        choice = rng.random()
+        if choice < 0.25 and (pending_writes["a"] or pending_writes["b"]):
+            side = rng.choice([s for s in "ab" if pending_writes[s]])
+            payload = f"{side}:{n_msgs - pending_writes[side]}".encode()
+            cores[side].write(payload)
+            sent[side].append(payload)
+            pending_writes[side] -= 1
+        elif choice < 0.85 and chan.in_flight:
+            # 15% drop, 10% duplicate on each delivered packet.
+            chan.step(drop_p=0.15, dup_p=0.10)
+        else:
+            # Epoch tick on a random side: retransmit + re-ack.
+            cores[rng.choice("ab")].on_epoch()
+
+    # Drain the channel fully (no more drops) and let retransmits finish.
+    for _ in range(10_000):
+        if not chan.step(drop_p=0.0, dup_p=0.0):
+            a.on_epoch()
+            b.on_epoch()
+            if a.drained and b.drained and not chan.in_flight:
+                break
+
+    assert delivered["b"] == sent["a"], f"a->b stream corrupted (seed={seed})"
+    assert delivered["a"] == sent["b"], f"b->a stream corrupted (seed={seed})"
+    assert a.drained and b.drained
+
+
+@pytest.mark.parametrize("seed", [3, 8, 2024])
+def test_window_never_exceeded(seed):
+    """At no point may the sender hold more than WindowSize unacked data
+    messages, nor send a seq beyond ack_base + WindowSize (rule 3).
+    (Stale already-acked packets may still float in the network — the
+    invariant is sender state, not channel contents.)"""
+    rng = random.Random(seed)
+    window = rng.choice([1, 2, 4])
+    chan, a, b, delivered = wire_pair(rng, window)
+    for i in range(50):
+        a.write(b"m%d" % i)
+        if rng.random() < 0.5:
+            chan.step(drop_p=0.3, dup_p=0.1)
+        if rng.random() < 0.2:
+            a.on_epoch()
+        assert len(a._unacked) <= window, (
+            f"{len(a._unacked)} unacked > window {window}"
+        )
+        for seq in a._unacked:
+            assert seq <= a._ack_base + window, (
+                f"seq {seq} beyond window gate {a._ack_base}+{window}"
+            )
+
+
+def test_duplicate_data_acked_but_not_redelivered():
+    rng = random.Random(5)
+    chan, a, b, delivered = wire_pair(rng, window=4)
+    a.write(b"x")
+    # Find the data packet and deliver it twice.
+    [(dst, msg)] = chan.in_flight
+    chan.in_flight.clear()
+    b.on_data(msg)
+    b.on_data(msg)
+    assert delivered["b"] == [b"x"]  # exactly once
+    # Both receipts generated an ack (immediate-ack rule 5).
+    acks = [m for _dst, m in chan.in_flight if m.type == MsgType.ACK]
+    assert len(acks) == 2 and all(m.seq_num == 1 for m in acks)
