@@ -208,6 +208,20 @@ def test_xla_backend_fleet():
         sys_.close()
 
 
+def test_multichip_mesh_miner_fleet():
+    """One miner process spanning the full 8-device virtual mesh via
+    --devices (shard_map + pmin cascade), serving a real fleet job — the
+    apps/miner.py glue over parallel/sweep.py (BASELINE's single ultra-fast
+    worker shape)."""
+    sys_ = MiningSystem(n_miners=0, min_chunk=500)
+    try:
+        sys_.add_miner(miner_mod.make_search("xla", devices=8))
+        res = sys_.request("meshminer", 2500)
+        assert res == min_hash_range("meshminer", 0, 2500)
+    finally:
+        sys_.close()
+
+
 def test_checkpoint_resume_fleet_restart(tmp_path):
     """Kill the whole fleet mid-job; a restarted server resumes from the
     checkpoint file and completes WITHOUT re-sweeping finished sub-ranges
