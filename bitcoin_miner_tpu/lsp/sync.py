@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+from concurrent.futures import CancelledError as FutureCancelledError
 from concurrent.futures import Future
 from typing import Optional, Tuple
 
@@ -57,7 +58,10 @@ class _LoopThread:
             raise ConnClosedError()
         try:
             return fut.result(timeout)
-        except asyncio.CancelledError:
+        except (asyncio.CancelledError, FutureCancelledError):
+            # Both spellings: run_coroutine_threadsafe's Future raises the
+            # concurrent.futures class when stop() cancels it before the
+            # coroutine ran, which is NOT asyncio.CancelledError here.
             raise ConnClosedError()
 
     def call(self, fn, *args):

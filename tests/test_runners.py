@@ -1,0 +1,74 @@
+"""Smoke tests for the srunner/crunner echo harnesses (dev-harness parity
+with the reference's srunner/crunner binaries, SURVEY §2.1): the real
+main() entry points echo traffic over loopback with the reference's flag
+sets (`srunner/srunner.go:15-23`, `crunner/crunner.go:16-25`).
+"""
+
+import io
+import threading
+import time
+
+import pytest
+
+from bitcoin_miner_tpu import lsp, lspnet
+from bitcoin_miner_tpu.apps import crunner, srunner
+from lsp_harness import random_port
+
+
+@pytest.fixture(autouse=True)
+def _clean_network():
+    lspnet.reset_faults()
+    yield
+    lspnet.reset_faults()
+
+
+def test_echo_loop_round_trip(monkeypatch, capsys):
+    params = lsp.Params(epoch_limit=5, epoch_millis=100, window_size=4)
+    server = lsp.Server(0, params)
+    st = threading.Thread(target=srunner.run_server, args=(server,), daemon=True)
+    st.start()
+    try:
+        client = lsp.Client("127.0.0.1", server.port, params)
+        monkeypatch.setattr("sys.stdin", io.StringIO("hello world\nfoo\n"))
+        crunner.run_client(client)
+        client.close()
+        out = capsys.readouterr().out
+        assert out.splitlines() == ["[echo] hello", "[echo] world", "[echo] foo"]
+    finally:
+        server.close()
+        st.join(timeout=5)
+
+
+def test_mains_with_reference_flags(monkeypatch, capsys):
+    """Drive the real srunner.main and crunner.main with the reference flag
+    sets end to end on loopback."""
+    port = random_port()
+    created = {}
+    real_server = lsp.Server
+
+    def capturing_server(*a, **k):
+        s = real_server(*a, **k)
+        created["server"] = s
+        return s
+
+    # Both runner modules resolve lsp.Server at call time through the shared
+    # lsp module, so patch the attribute there (undone by monkeypatch).
+    monkeypatch.setattr(lsp, "Server", capturing_server)
+    flags = ["-elim", "5", "-ems", "100", "-wsize", "4"]
+    st = threading.Thread(
+        target=srunner.main, args=(["-port", str(port)] + flags,), daemon=True
+    )
+    st.start()
+    deadline = time.time() + 5
+    while "server" not in created and time.time() < deadline:
+        time.sleep(0.02)
+    assert "server" in created, "srunner.main never bound its server"
+
+    monkeypatch.setattr("sys.stdin", io.StringIO("ping pong\n"))
+    rc = crunner.main(["-host", "127.0.0.1", "-port", str(port)] + flags)
+    assert rc == 0
+    assert capsys.readouterr().out.splitlines() == ["[echo] ping", "[echo] pong"]
+
+    created["server"].close()  # unblocks run_server -> srunner.main returns
+    st.join(timeout=5)
+    assert not st.is_alive()
