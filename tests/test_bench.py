@@ -1,0 +1,38 @@
+"""Driver-artifact contract test: `python bench.py` must always emit
+exactly one parseable JSON line on stdout with the fields the driver and
+judge read (BENCH_r{N}.json).  Round 1 lost its entire perf artifact to an
+unguarded backend init; this pins the hardened contract.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def run_bench(*flags):
+    return subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), *flags],
+        capture_output=True,
+        text=True,
+        timeout=560,
+        cwd=REPO,
+    )
+
+
+def test_cpu_bench_emits_one_valid_json_line():
+    p = run_bench("--cpu")
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = p.stdout.strip().splitlines()
+    assert len(lines) == 1, f"stdout must be exactly one JSON line: {lines}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "nonces_per_sec_per_chip"
+    assert out["unit"] == "nonces/s"
+    assert out["value"] > 0
+    assert out["vs_baseline"] == round(out["value"] / 1e9, 4)
+    # Attribution fields (VERDICT round 1: numbers must be attributable).
+    assert out["platform"] == "cpu"
+    assert out["backend"] in ("native", "xla")
+    assert "device_kind" in out
