@@ -55,6 +55,15 @@ def make_search(backend: str = "auto", devices: Optional[int] = None) -> SearchF
             if not is_tpu():
                 return make_search("cpu")
         backend = None  # let the ops layer pick pallas-on-TPU / xla elsewhere
+
+    # JAX tiers: persistent compile cache so miner restarts skip the
+    # 20-40s first compile per shape class.
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", "/tmp/bitcoin_miner_tpu_jax_cache"
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     if devices is not None and devices != 1:
         if devices < 1:
             raise ValueError(f"--devices must be >= 1, got {devices}")

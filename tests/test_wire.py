@@ -125,3 +125,50 @@ class TestHashOracle:
             ((bitcoin.hash_nonce("cmu440", i), i) for i in range(501)),
         )
         assert (h, n) == best
+
+
+class TestCodecFuzz:
+    """Malformed datagrams must decode to None (dropped), never raise —
+    a junk UDP packet must not kill a transport loop or the scheduler."""
+
+    def _fuzz_inputs(self, seed=0, count=400):
+        import json
+        import random
+
+        rng = random.Random(seed)
+        cases = [
+            b"", b"{", b"[]", b"null", b"5", b'"str"', b"\xff\xfe\x00",
+            b'{"Type": "x"}', b'{"Type": 99}', b'{"Type": true}',
+            b'{"Payload": "!!!notb64"}', b'{"Payload": 5}',
+            b'{"SeqNum": "NaN"}', b'{"Size": []}', b'{"ConnID": {}}',
+            b'{"Lower": -1}', b'{"Upper": 18446744073709551616}',
+            b'{"Hash": 1.5}', b'{"Nonce": true}', b'{"Data": 7}',
+        ]
+        for _ in range(count):
+            n = rng.randint(0, 60)
+            cases.append(bytes(rng.randrange(256) for _ in range(n)))
+            # Structured junk: random JSON with reference-ish keys.
+            obj = {
+                rng.choice(["Type", "ConnID", "SeqNum", "Size", "Payload",
+                            "Data", "Lower", "Upper", "Hash", "Nonce", "X"]):
+                rng.choice([rng.randint(-(2**70), 2**70), "x", None, True,
+                            [1], {"a": 1}, 1.25])
+                for _ in range(rng.randint(0, 4))
+            }
+            cases.append(json.dumps(obj).encode())
+        return cases
+
+    def test_lsp_unmarshal_never_raises(self):
+        for buf in self._fuzz_inputs(seed=1):
+            m = lsp.Message.unmarshal(buf)
+            assert m is None or isinstance(m, lsp.Message)
+
+    def test_bitcoin_unmarshal_never_raises(self):
+        for buf in self._fuzz_inputs(seed=2):
+            m = bitcoin.Message.unmarshal(buf)
+            assert m is None or isinstance(m, bitcoin.Message)
+
+    def test_valid_messages_survive_fuzz_suite(self):
+        # Sanity: the fuzz helpers didn't accidentally cover valid shapes.
+        assert lsp.Message.unmarshal(lsp.Message.connect().marshal())
+        assert bitcoin.Message.unmarshal(bitcoin.Message.join().marshal())
