@@ -321,3 +321,97 @@ def test_client_disconnected_output():
     t.join(timeout=30)
     assert not t.is_alive()
     assert out.getvalue() == "Disconnected\n"
+
+
+def test_ticker_survives_checkpoint_write_failure():
+    """An unwritable checkpoint path must not kill the ticker thread (and
+    with it straggler recovery) — the serve loop logs and keeps going."""
+    server = lsp.Server(0, PARAMS)
+    sched = Scheduler(min_chunk=500)
+    t = threading.Thread(
+        target=server_mod.serve,
+        args=(server, sched),
+        kwargs={
+            "tick_interval": 0.05,
+            "checkpoint_path": "/nonexistent-dir/ckpt.json",
+        },
+        daemon=True,
+    )
+    t.start()
+    try:
+        m = lsp.Client("127.0.0.1", server.port, PARAMS)
+        threading.Thread(
+            target=miner_mod.run_miner,
+            args=(m, miner_mod.make_search("cpu")),
+            daemon=True,
+        ).start()
+        time.sleep(0.5)  # several failing ticks elapse
+        c = lsp.Client("127.0.0.1", server.port, PARAMS)
+        try:
+            res = client_mod.request_once(c, "tickerok", 3000)
+        finally:
+            c.close()
+        assert res == min_hash_range("tickerok", 0, 3000)
+    finally:
+        server.close()
+
+
+def test_adversarial_fleet_soak():
+    """Everything at once, live: 20% packet loss, a permanently hung miner
+    (straggler tick reclaims), a lying miner (validation evicts), a slow
+    miner, and concurrent jobs — every client still gets the bit-exact
+    min (BASELINE configs 3+5 combined, plus this framework's guards)."""
+    server = lsp.Server(0, PARAMS)
+    sched = Scheduler(min_chunk=400, straggler_min_seconds=4.0)
+    threading.Thread(
+        target=server_mod.serve,
+        args=(server, sched),
+        kwargs={"tick_interval": 0.2},
+        daemon=True,
+    ).start()
+
+    def add(search):
+        c = lsp.Client("127.0.0.1", server.port, PARAMS)
+        threading.Thread(
+            target=miner_mod.run_miner, args=(c, search), daemon=True
+        ).start()
+        return c
+
+    hold = threading.Event()
+    try:
+        for _ in range(5):
+            add(miner_mod.make_search("cpu"))
+        add(lambda d, lo, hi: hold.wait(3600))  # hung: straggler path
+        add(lambda d, lo, hi: (12345, lo))  # liar: validation path
+
+        def slow(d, lo, hi):
+            time.sleep(0.2)
+            return min_hash_range(d, lo, hi)
+
+        add(slow)
+
+        lspnet.set_write_drop_percent(20)
+        jobs = [(f"soak{i}", 3000 + 500 * i) for i in range(4)]
+        results = {}
+
+        def run_job(data, mx):
+            c = lsp.Client("127.0.0.1", server.port, PARAMS)
+            try:
+                results[data] = client_mod.request_once(c, data, mx)
+            finally:
+                c.close()
+
+        ths = [
+            threading.Thread(target=run_job, args=j, daemon=True) for j in jobs
+        ]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120)
+            assert not t.is_alive(), "client starved"
+        for data, mx in jobs:
+            assert results[data] == min_hash_range(data, 0, mx), data
+    finally:
+        hold.set()
+        lspnet.reset_faults()
+        server.close()
