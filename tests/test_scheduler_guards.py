@@ -31,6 +31,7 @@ def results(actions):
 
 class TestResultValidation:
     def test_honest_result_accepted(self):
+        METRICS.reset()
         s = Scheduler(min_chunk=1000)
         s.miner_joined(1)
         s.client_request(10, DATA, 0, 99)
