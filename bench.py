@@ -125,18 +125,21 @@ def main() -> int:
 
     import jax
 
+    from bitcoin_miner_tpu.utils.platform import (
+        device_desc,
+        enable_compile_cache,
+        is_tpu,
+    )
+
     if probed is None:
         # Force CPU before any backend init (env vars are too late here:
         # sitecustomize imports jax at boot with the TPU plugin selected).
         jax.config.update("jax_platforms", "cpu")
-    # Repeat bench runs shouldn't re-pay the 20-40s first compile.
-    jax.config.update("jax_compilation_cache_dir", "/tmp/bitcoin_miner_tpu_jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    enable_compile_cache()
 
     from bitcoin_miner_tpu import native
     from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
     from bitcoin_miner_tpu.ops.sweep import sweep_min_hash
-    from bitcoin_miner_tpu.utils.platform import device_desc, is_tpu
 
     dev = jax.devices()[0]
     platform = dev.platform

@@ -56,14 +56,11 @@ def make_search(backend: str = "auto", devices: Optional[int] = None) -> SearchF
                 return make_search("cpu")
         backend = None  # let the ops layer pick pallas-on-TPU / xla elsewhere
 
-    # JAX tiers: persistent compile cache so miner restarts skip the
-    # 20-40s first compile per shape class.
-    import jax
+    # JAX tiers: persistent compile cache so miner restarts skip the first
+    # compile per shape class.
+    from ..utils.platform import enable_compile_cache
 
-    jax.config.update(
-        "jax_compilation_cache_dir", "/tmp/bitcoin_miner_tpu_jax_cache"
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    enable_compile_cache()
     if devices is not None and devices != 1:
         if devices < 1:
             raise ValueError(f"--devices must be >= 1, got {devices}")

@@ -122,6 +122,9 @@ class Scheduler:
         self._job_rr: Deque[int] = deque()  # round-robin order of job ids
         self._banned: set = set()  # evicted conn ids: Joins refused for good
         self._evicted: List[int] = []  # conns the shell should close
+        #: Bumped by every state-mutating event; lets the server shell skip
+        #: rebuilding+rewriting an unchanged checkpoint on idle ticks.
+        self.revision = 0
         # Checkpointed progress awaiting a matching resubmitted Request:
         # job key -> (best, remaining intervals).
         self._resume: Dict[JobKey, Tuple[Optional[Tuple[int, int]], List[Interval]]] = {}
@@ -131,6 +134,7 @@ class Scheduler:
     # ------------------------------------------------------------------ events
 
     def miner_joined(self, conn_id: int, now: float = 0.0) -> List[Action]:
+        self.revision += 1
         if conn_id in self.miners or conn_id in self.jobs:
             return []  # duplicate Join / role confusion: ignore
         if conn_id in self._banned:
@@ -141,6 +145,7 @@ class Scheduler:
     def client_request(
         self, conn_id: int, data: str, lower: int, upper: int, now: float = 0.0
     ) -> List[Action]:
+        self.revision += 1
         if conn_id in self.jobs or conn_id in self.miners:
             return []  # one job per client conn; ignore repeats
         if lower < 0 or upper >= 1 << 64:
@@ -166,6 +171,7 @@ class Scheduler:
     def result(
         self, conn_id: int, hash_: int, nonce: int, now: float = 0.0
     ) -> List[Action]:
+        self.revision += 1
         miner = self.miners.get(conn_id)
         if miner is None or miner.interval is None:
             return []  # Result from a non-miner or an unassigned miner
@@ -209,6 +215,7 @@ class Scheduler:
 
     def lost(self, conn_id: int, now: float = 0.0) -> List[Action]:
         """A connection died — miner or client, we find out here."""
+        self.revision += 1
         miner = self.miners.pop(conn_id, None)
         if miner is not None:
             job = self.jobs.get(miner.job) if miner.job is not None else None
@@ -258,6 +265,7 @@ class Scheduler:
             job.pending.appendleft(miner.interval)
             job.requeued[miner.conn_id] = miner.interval
             METRICS.inc("sched.chunks_straggler_requeued")
+            self.revision += 1
             reclaimed = True
         return self._dispatch(now) if reclaimed else []
 

@@ -77,16 +77,23 @@ def serve(
     stop = threading.Event()
 
     def ticker() -> None:
+        saved_rev = None
         while not stop.wait(tick_interval):
             try:
                 with lock:
                     actions = sched.tick(clock())
-                    state = sched.checkpoint() if checkpoint_path else None
+                    rev = sched.revision
+                    state = (
+                        sched.checkpoint()
+                        if checkpoint_path and rev != saved_rev
+                        else None
+                    )
                 if actions:
                     log.info("straggler tick reclaimed work")
                     emit(actions)
-                if checkpoint_path and state is not None:
+                if state is not None:
                     save_checkpoint(checkpoint_path, state)
+                    saved_rev = rev
             except Exception:
                 # A transient failure (e.g. checkpoint disk full) must not
                 # silently kill straggler recovery for the server's lifetime.

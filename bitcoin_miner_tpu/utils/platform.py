@@ -38,3 +38,35 @@ def device_desc(dev) -> str:
     """Human-readable one-liner for logs: platform + device_kind."""
     kind = getattr(dev, "device_kind", None) or "?"
     return f"{dev.platform}:{kind}"
+
+
+def force_virtual_cpu(n_devices: int) -> None:
+    """Force this process onto ``n_devices`` virtual CPU devices.
+
+    Must run before any backend initializes.  Env vars alone are too late
+    in environments whose sitecustomize imports jax at interpreter boot
+    with an accelerator plugin selected, so the platform override goes
+    through ``jax.config``; ``XLA_FLAGS`` is still read at backend init.
+    Used by the test conftest and the driver's multichip dryrun.
+    """
+    import os
+
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # a backend already initialized; leave the caller's setup alone
+
+
+def enable_compile_cache(
+    path: str = "/tmp/bitcoin_miner_tpu_jax_cache",
+) -> None:
+    """Persistent XLA compilation cache: kernel shape classes take 20-40s
+    to compile on TPU (seconds on CPU); restarts and repeat runs skip it."""
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

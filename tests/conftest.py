@@ -11,19 +11,12 @@ backend init and can still be set here.  bench.py and the driver's graft
 entry run outside pytest and therefore see the real TPU.
 """
 
-import os
+from bitcoin_miner_tpu.utils.platform import (
+    enable_compile_cache,
+    force_virtual_cpu,
+)
 
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu(8)
 # XLA:CPU compiles of the sweep kernels take seconds each; cache them across
 # pytest runs so only the first invocation pays.
-jax.config.update("jax_compilation_cache_dir", "/tmp/bitcoin_miner_tpu_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+enable_compile_cache()
