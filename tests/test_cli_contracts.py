@@ -1,0 +1,70 @@
+"""CLI argv/usage contracts of the three mining binaries.
+
+The reference freezes the argv shapes and error text shapes
+(`bitcoin/client/client.go:12-23`, `bitcoin/server/server.go:41-51`,
+`bitcoin/miner/miner.go:18-24`); these pin the error paths that the e2e
+suites never hit (the happy paths are covered there).
+"""
+
+import io
+
+from bitcoin_miner_tpu.apps import client as client_mod
+from bitcoin_miner_tpu.apps import miner as miner_mod
+from bitcoin_miner_tpu.apps import server as server_mod
+
+
+class TestClientCLI:
+    def test_usage_on_wrong_argc(self):
+        out = io.StringIO()
+        assert client_mod.main(["client"], out=out) == 0
+        assert out.getvalue() == "Usage: ./client <hostport> <message> <maxNonce>"
+
+    def test_non_numeric_max_nonce(self):
+        out = io.StringIO()
+        client_mod.main(["client", "h:1", "msg", "abc"], out=out)
+        assert out.getvalue() == "abc is not a number.\n"
+
+    def test_out_of_u64_max_nonce(self):
+        out = io.StringIO()
+        client_mod.main(["client", "h:1", "msg", str(1 << 64)], out=out)
+        assert out.getvalue() == f"{1 << 64} is not a number.\n"
+
+    def test_connect_failure_reported_not_raised(self):
+        out = io.StringIO()
+        # Unparseable port: must print a failure line, not traceback.
+        assert client_mod.main(["client", "nocolonhere", "m", "5"], out=out) == 0
+        assert out.getvalue().startswith("Failed to connect to server:")
+
+
+class TestServerCLI:
+    def test_usage_on_wrong_argc(self, capsys):
+        assert server_mod.main(["server"]) == 0
+        assert (
+            capsys.readouterr().out
+            == "Usage: ./server <port> [--checkpoint=FILE]"
+        )
+
+    def test_non_numeric_port(self, capsys):
+        assert server_mod.main(["server", "notaport"]) == 0
+        assert capsys.readouterr().out.startswith("Port must be a number:")
+
+
+class TestMinerCLI:
+    def test_usage_on_missing_hostport(self, capsys):
+        assert miner_mod.main(["miner"]) == 0
+        assert capsys.readouterr().out == "Usage: ./miner <hostport>"
+
+    def test_invalid_device_count_reported(self, capsys):
+        assert miner_mod.main(["miner", "h:1", "--devices", "0"]) == 0
+        assert capsys.readouterr().out.startswith("Invalid miner configuration:")
+
+    def test_cpu_backend_with_mesh_rejected(self, capsys):
+        assert (
+            miner_mod.main(["miner", "h:1", "--backend", "cpu", "--devices", "8"])
+            == 0
+        )
+        assert capsys.readouterr().out.startswith("Invalid miner configuration:")
+
+    def test_multihost_requires_topology_flags(self, capsys):
+        assert miner_mod.main(["miner", "h:1", "--multihost"]) == 0
+        assert "requires" in capsys.readouterr().out
