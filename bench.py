@@ -160,12 +160,16 @@ def main() -> int:
     )
 
     tuned_batch = None  # None = the tier's default chunks-per-dispatch
+    tuned_tile = None  # None = the pallas tier's default lanes-per-program
 
     def run(d: str, lo: int, hi: int, max_k=None):
         if backend == "native":
             h, n = native.min_hash_range_native(d, lo, hi)
             return h, n, hi - lo + 1
-        r = sweep_min_hash(d, lo, hi, backend=backend, max_k=max_k, batch=tuned_batch)
+        r = sweep_min_hash(
+            d, lo, hi, backend=backend, max_k=max_k,
+            batch=tuned_batch, tile=tuned_tile,
+        )
         return r.hash, r.nonce, r.lanes_swept
 
     # -- correctness gate ---------------------------------------------------
@@ -209,24 +213,29 @@ def main() -> int:
     timed(warm)  # compile
 
     if args.autotune and backend != "native":
-        # Dispatch-size sweep: the pallas superbatch trades dispatch latency
-        # (O(100ms) on tunnelled TPUs) against per-call memory; measure a
-        # fixed workload at each candidate and keep the fastest.
-        candidates = (
-            [256, 512, 1024, 2048] if backend == "pallas" else [4, 8, 16, 32]
-        )
-        probe_n = 10**8 if backend == "pallas" else 4 * 10**6
+        # Dispatch-shape sweep: the pallas superbatch trades dispatch
+        # latency (O(100ms) on tunnelled TPUs) against per-call memory, and
+        # tile sets the VMEM blocking per grid program; measure a fixed
+        # workload at each candidate and keep the fastest.
+        if backend == "pallas":
+            candidates = [
+                (b, t) for b in (256, 512, 1024, 2048) for t in (4096, 8192, 16384)
+            ]
+            probe_n = 10**8
+        else:
+            candidates = [(b, None) for b in (4, 8, 16, 32)]
+            probe_n = 4 * 10**6
         best_rate = 0.0
-        for cand in candidates:
-            tuned_batch = cand
+        for cand_batch, cand_tile in candidates:
+            tuned_batch, tuned_tile = cand_batch, cand_tile
             timed(min(probe_n, 10**6))  # compile this shape class
             dt = timed(probe_n)
             rate = probe_n / dt
-            log(f"autotune batch={cand}: {rate:,.0f} nonces/s")
+            log(f"autotune batch={cand_batch} tile={cand_tile}: {rate:,.0f} nonces/s")
             if rate > best_rate:
-                best_rate, best = rate, cand
-        tuned_batch = best
-        log(f"autotune picked batch={tuned_batch}")
+                best_rate, best = rate, (cand_batch, cand_tile)
+        tuned_batch, tuned_tile = best
+        log(f"autotune picked batch={tuned_batch} tile={tuned_tile}")
 
     n = 4 * 10**6
     dt = timed(n)
@@ -252,6 +261,8 @@ def main() -> int:
     }
     if tuned_batch is not None:
         out["batch"] = tuned_batch
+    if tuned_tile is not None:
+        out["tile"] = tuned_tile
     if warning:
         out["warning"] = warning
     emit(out)

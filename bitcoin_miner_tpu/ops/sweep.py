@@ -288,6 +288,7 @@ def sweep_min_hash(
     *,
     max_k: Optional[int] = None,
     batch: Optional[int] = None,
+    tile: Optional[int] = None,
     backend: Optional[str] = None,
     interpret: bool = False,
 ) -> SweepResult:
@@ -303,6 +304,7 @@ def sweep_min_hash(
     ``batch`` = chunks per dispatch.  Dispatch+fetch latency on tunnelled
     TPUs is O(100 ms), so the pallas tier defaults to a large super-batch
     (~1e9 nonces/dispatch); padding rows are skipped in-kernel.
+    ``tile`` = lanes per pallas grid program (VMEM blocking; pallas only).
     """
     backend, batch, max_k = auto_tune(backend, batch, max_k)
     rolled = not is_tpu()
@@ -310,10 +312,15 @@ def sweep_min_hash(
     def get_kernel(layout, group):
         low_pos = layout.digit_pos[layout.digit_count - group.k :]
         if backend == "pallas":
-            from .pallas_sha256 import make_pallas_minhash
+            from .pallas_sha256 import DEFAULT_TILE, make_pallas_minhash
 
             return make_pallas_minhash(
-                layout.n_tail_blocks, low_pos, group.k, batch, interpret=interpret
+                layout.n_tail_blocks,
+                low_pos,
+                group.k,
+                batch,
+                tile=tile if tile is not None else DEFAULT_TILE,
+                interpret=interpret,
             )
         return _make_kernel(layout.n_tail_blocks, low_pos, group.k, batch, rolled)
 

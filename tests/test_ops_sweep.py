@@ -120,3 +120,12 @@ class TestPallasTier:
         )
         rx = sweep_min_hash(data, lo, hi, backend="xla", max_k=2)
         assert (rp.hash, rp.nonce) == (rx.hash, rx.nonce)
+
+    def test_non_default_tile(self):
+        # The autotune path plumbs tile through sweep_min_hash; a clamped
+        # non-default tile must stay bit-exact.
+        r = sweep_min_hash(
+            "abc", 95, 321, backend="pallas", interpret=True,
+            batch=2, max_k=2, tile=2048,
+        )
+        assert (r.hash, r.nonce) == min_hash_range("abc", 95, 321)
