@@ -29,8 +29,10 @@ Correctness contract: ``Hash = BigEndian.Uint64(SHA256("<data> <nonce>")
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
+import threading
 import time
 
 
@@ -40,6 +42,44 @@ def log(*a) -> None:
 
 def emit(obj) -> None:
     print(json.dumps(obj), flush=True)
+
+
+class Watchdog:
+    """Guard against in-process hangs AFTER the subprocess probe: the TPU
+    tunnel can wedge between the probe and the real ``jax.devices()`` /
+    first compile, and a wedged PJRT call never raises — without this the
+    bench dies with no JSON artifact (the round-1 failure mode).
+
+    Heartbeat-based: the monitor thread hard-exits with an error JSON line
+    if ``beat()`` hasn't been called for ``timeout`` seconds.  ``os._exit``
+    because a wedged PJRT client cannot be unwound by exceptions.
+    """
+
+    def __init__(self, timeout: float, stage: str = "backend init") -> None:
+        self.timeout = timeout
+        self.stage = stage
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def beat(self, stage: str = None) -> None:
+        self._last = time.monotonic()
+        if stage is not None:
+            self.stage = stage
+
+    def disarm(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(min(self.timeout / 4, 5.0)):
+            idle = time.monotonic() - self._last
+            if idle > self.timeout:
+                log(f"WATCHDOG: '{self.stage}' hung {idle:.0f}s; aborting")
+                emit({"error": f"{self.stage} hung >{self.timeout:.0f}s"})
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os._exit(2)
 
 
 _PROBE = (
@@ -123,6 +163,14 @@ def main() -> int:
         warning = "accelerator backend unavailable; CPU fallback number"
         log(f"WARNING: {warning}")
 
+    # Everything in-process from here (jax import, device init, compiles,
+    # timed runs) beats this watchdog; a wedge still lands a JSON artifact.
+    watchdog = Watchdog(
+        float(os.environ.get("BENCH_WATCHDOG_SECS", "300")), "jax import"
+    )
+    if os.environ.get("BENCH_SIMULATE_WEDGE"):  # test hook (test_bench.py)
+        time.sleep(float(os.environ["BENCH_SIMULATE_WEDGE"]))
+
     import jax
 
     from bitcoin_miner_tpu.utils.platform import (
@@ -141,6 +189,7 @@ def main() -> int:
     from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
     from bitcoin_miner_tpu.ops.sweep import sweep_min_hash
 
+    watchdog.beat("device init (jax.devices)")
     dev = jax.devices()[0]
     platform = dev.platform
     device_kind = getattr(dev, "device_kind", "") or ""
@@ -175,6 +224,7 @@ def main() -> int:
     # -- correctness gate ---------------------------------------------------
     data = "cmu440"
     lo, hi = 95, 1205  # crosses 2->3->4 digit boundaries
+    watchdog.beat("correctness gate (first compile)")
     try:
         h, n, _ = run(data, lo, hi, max_k=2)
     except Exception as e:  # pallas tier unavailable -> fall back, still bench
@@ -203,10 +253,12 @@ def main() -> int:
     base = 10**9
 
     def timed(n: int) -> float:
+        watchdog.beat(f"timed sweep of {n} nonces")
         t0 = time.perf_counter()
         _h, _n, swept = run(data, base, base + n - 1)
         dt = time.perf_counter() - t0
         assert swept == n
+        watchdog.beat()
         return dt
 
     warm = 10**6
@@ -247,6 +299,7 @@ def main() -> int:
         with jax.profiler.trace(args.profile):
             timed(n)
         log(f"profiler trace written to {args.profile}")
+    watchdog.disarm()
     rate = n / dt
     log(f"swept {n} nonces in {dt:.3f}s -> {rate:,.0f} nonces/s")
 

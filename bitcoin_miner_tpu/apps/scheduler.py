@@ -276,39 +276,39 @@ class Scheduler:
         remaining (pending + outstanding + previously checkpointed) work.
         JSON-serializable; feed to ``load_checkpoint`` / ``resume_state``.
         """
-        jobs = []
+        merged: Dict[JobKey, Tuple[Optional[Tuple[int, int]], List[Interval]]] = {}
         for job in self.jobs.values():
             remaining = list(job.pending) + list(job.outstanding.values())
-            jobs.append(
-                {
-                    "data": job.data,
-                    "lower": job.lower,
-                    "upper": job.upper,
-                    "best": list(job.best) if job.best else None,
-                    "remaining": [list(iv) for iv in _merge_intervals(remaining)],
-                }
-            )
-        # Orphaned progress (job's client died / fleet restarted) persists too.
+            _merge_progress(merged, job.key, job.best, remaining)
+        # Orphaned progress (job's client died / fleet restarted) persists
+        # too.  Same-key entries (live job + orphan, or two identical
+        # concurrent jobs) MERGE rather than duplicate: a later last-wins
+        # load must never let a staler snapshot overwrite fresher progress.
         for key, (best, remaining) in self._resume.items():
-            jobs.append(
-                {
-                    "data": key[0],
-                    "lower": key[1],
-                    "upper": key[2],
-                    "best": list(best) if best else None,
-                    "remaining": [list(iv) for iv in remaining],
-                }
-            )
+            _merge_progress(merged, key, best, remaining)
+        jobs = [
+            {
+                "data": key[0],
+                "lower": key[1],
+                "upper": key[2],
+                "best": list(best) if best else None,
+                "remaining": [list(iv) for iv in remaining],
+            }
+            for key, (best, remaining) in merged.items()
+        ]
         return {"version": 1, "jobs": jobs}
 
     def load_checkpoint(self, state: dict) -> None:
         """Stage checkpointed progress; consumed when a client resubmits the
-        identical ``(data, lower, upper)`` Request."""
+        identical ``(data, lower, upper)`` Request.  Duplicate keys — in the
+        state, or already staged — merge conservatively: best-so-far
+        min-folds and remaining work unions, so no snapshot ordering can
+        lose progress or skip unswept nonces."""
         for j in state.get("jobs", ()):
             key = (j["data"], j["lower"], j["upper"])
             best = tuple(j["best"]) if j.get("best") else None
             remaining = [tuple(iv) for iv in j["remaining"]]
-            self._resume[key] = (best, remaining)
+            _merge_progress(self._resume, key, best, remaining)
 
     # ------------------------------------------------------------------ internals
 
@@ -419,6 +419,26 @@ def _subtract_pending(job: _Job, cut: Interval) -> None:
         if phi > hi:
             kept.append((hi + 1, phi))
     job.pending = kept
+
+
+def _merge_progress(
+    into: Dict[JobKey, Tuple[Optional[Tuple[int, int]], List[Interval]]],
+    key: JobKey,
+    best: Optional[Tuple[int, int]],
+    remaining: List[Interval],
+) -> None:
+    """Fold one job snapshot into ``into[key]``.  Conservative on both axes:
+    ``best`` takes the minimum (every candidate is a real in-range hash, so
+    min never fabricates progress) and ``remaining`` takes the union (an
+    unswept nonce in either snapshot stays unswept — re-sweeping overlap is
+    harmless, skipping it would be wrong)."""
+    prev = into.get(key)
+    if prev is not None:
+        pbest, prem = prev
+        if best is None or (pbest is not None and pbest < best):
+            best = pbest
+        remaining = remaining + prem
+    into[key] = (best, _merge_intervals(list(remaining)))
 
 
 def _merge_intervals(intervals: List[Interval]) -> List[Interval]:

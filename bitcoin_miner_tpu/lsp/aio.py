@@ -216,6 +216,7 @@ class AsyncServer:
         self._reader_task: Optional[asyncio.Task] = None
         self._closing = False  # close() in progress: no new connections
         self._closed = False
+        self._drained = asyncio.Event()  # set when closing and no conns left
 
     @classmethod
     async def create(
@@ -276,8 +277,11 @@ class AsyncServer:
             sc.core.begin_close()
             if sc.core.drained:
                 self._finish_conn(sc)
-        while self._conns:
-            await asyncio.sleep(self._params.epoch_seconds / 10)
+        if not self._conns:
+            self._drained.set()
+        # Event-driven: _finish_conn fires the event when the last conn
+        # drains (final ack) or is declared lost — no polling tick.
+        await self._drained.wait()
         self._closed = True
         if self._reader_task:
             self._reader_task.cancel()
@@ -293,6 +297,8 @@ class AsyncServer:
             sc.epoch_task.cancel()
         self._conns.pop(sc.core.conn_id, None)
         self._by_addr.pop(sc.addr, None)
+        if self._closing and not self._conns:
+            self._drained.set()
 
     def _new_conn(self, addr: Addr) -> _ServerConn:
         conn_id = self._next_id

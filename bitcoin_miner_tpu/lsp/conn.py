@@ -118,8 +118,18 @@ class ConnCore:
             return  # truncated in flight: drop silently, no ack
         if len(payload) > msg.size:
             payload = payload[: msg.size]
-        self._send(Message.ack(self.conn_id, msg.seq_num))
         seq = msg.seq_num
+        if seq > self._expected + 2 * self.params.window_size:
+            # Reorder horizon: a compliant sender can't exceed
+            # expected + WindowSize - 1 (its window gate is ack_base + W and
+            # a contiguously-acked prefix was necessarily received here, so
+            # ack_base < expected).  Anything far beyond is a hostile or
+            # broken peer trying to balloon the reorder buffer — drop it
+            # unacked (the ref shares this DoS hole, client_impl.go:277-289;
+            # 2x is slack, not protocol headroom).
+            METRICS.inc("lsp.dropped_horizon")
+            return
+        self._send(Message.ack(self.conn_id, msg.seq_num))
         if seq < self._expected:
             return  # duplicate of already-delivered data
         self.received_any_data = True

@@ -90,12 +90,21 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.sha256_sweep_min_mt.restype = None
+        lib.sha256_have_shani.argtypes = []
+        lib.sha256_have_shani.restype = ctypes.c_int
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def have_shani() -> bool:
+    """Whether this CPU runs the SHA-NI compression paths (incl. the 2-way
+    interleave) — False also when the native tier itself is unavailable."""
+    lib = _load()
+    return bool(lib is not None and lib.sha256_have_shani())
 
 
 def min_hash_range_native(
@@ -110,6 +119,11 @@ def min_hash_range_native(
         raise ValueError(f"empty nonce range [{lower}, {upper}]")
     if lower < 0 or upper >= 1 << 64:
         raise ValueError(f"nonce range out of uint64: [{lower}, {upper}]")
+    if lower == 0 and upper == (1 << 64) - 1:
+        # The full range's 2^64-nonce count wraps u64 span arithmetic, and a
+        # sweep of it is ~580 years at 1e9/s — refuse fast instead of
+        # launching a call that can never return.  Split the range.
+        raise ValueError("full 2^64-nonce range not supported; split it")
     if threads < 0:
         raise ValueError(f"threads must be >= 0, got {threads}")
     lib = _load()

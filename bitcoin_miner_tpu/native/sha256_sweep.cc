@@ -258,6 +258,11 @@ struct Tail {
 
 extern "C" {
 
+// Whether the SHA-NI compression paths (compress_shani / compress_shani_x2)
+// are live on this CPU — exposed so Python tests can record which path the
+// sweep actually exercised rather than passing silently either way.
+int sha256_have_shani() { return have_shani() ? 1 : 0; }
+
 // Sweep the inclusive nonce range [lower, upper]; returns the min hash and
 // its (lowest) nonce through the out params.
 void sha256_sweep_min(const uint8_t *data, uint64_t data_len, uint64_t lower,
@@ -399,7 +404,11 @@ void sha256_sweep_min_mt(const uint8_t *data, uint64_t data_len,
   uint64_t span = upper - lower + 1;  // callers guarantee lower <= upper
   uint64_t t = nthreads ? nthreads : std::thread::hardware_concurrency();
   if (t < 1) t = 1;
-  if (t > span) t = span;
+  if (span == 0) t = 1;  // full [0, 2^64-1]: 2^64 nonces wraps the u64 span,
+  // and span/t below would divide by zero.  The Python binding refuses this
+  // range outright (no sweep of 2^64 nonces ever returns in practice); for
+  // a direct C caller the scalar path is the defined — if eternal — answer.
+  if (t > span && span != 0) t = span;
   if (t == 1) {
     sha256_sweep_min(data, data_len, lower, upper, out_hash, out_nonce);
     return;

@@ -12,14 +12,37 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parents[1]
 
 
-def run_bench(*flags):
+def run_bench(*flags, env=None, timeout=560):
+    full_env = None
+    if env:
+        import os
+
+        full_env = {**os.environ, **env}
     return subprocess.run(
         [sys.executable, str(REPO / "bench.py"), *flags],
         capture_output=True,
         text=True,
-        timeout=560,
+        timeout=timeout,
         cwd=REPO,
+        env=full_env,
     )
+
+
+def test_post_probe_wedge_still_emits_json():
+    """If the in-process backend init hangs AFTER the subprocess probe (the
+    tunnel wedging between probe and jax.devices), the watchdog must still
+    land an error JSON artifact instead of hanging forever (round-1 failure
+    mode; VERDICT r3 weak-item 4)."""
+    p = run_bench(
+        "--cpu",
+        env={"BENCH_WATCHDOG_SECS": "2", "BENCH_SIMULATE_WEDGE": "60"},
+        timeout=30,
+    )
+    assert p.returncode == 2, (p.returncode, p.stderr[-500:])
+    lines = p.stdout.strip().splitlines()
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert "hung" in out["error"]
 
 
 def test_cpu_bench_emits_one_valid_json_line():

@@ -140,6 +140,45 @@ def test_window_never_exceeded(seed):
             )
 
 
+@pytest.mark.parametrize("seed", [11, 4242])
+def test_hostile_seq_flood_memory_bounded(seed):
+    """A hostile peer spraying Data with arbitrarily large seq_nums must not
+    grow the reorder buffer (or earn acks) beyond the protocol horizon —
+    previously every such packet was buffered AND acked, an unbounded-memory
+    DoS the reference shares (client_impl.go:277-289)."""
+    rng = random.Random(seed)
+    window = rng.choice([1, 4, 32])
+    chan, a, b, delivered = wire_pair(rng, window)
+    horizon = 2 * window
+    # Legitimate out-of-order data inside the horizon MUST still buffer and
+    # earn acks (rule 4/5) — the horizon is a DoS cap, not a reorder ban.
+    in_horizon = list(range(2, 2 + horizon))
+    for seq in in_horizon:
+        b.on_data(Message.data(1, seq, 1, b"g"))
+    assert set(b._reorder) == set(in_horizon), "legit reorder data not buffered"
+    for _ in range(5_000):
+        seq = rng.choice(
+            [
+                rng.randint(horizon + 2, 100 * window),
+                rng.randint(10**6, 10**12),
+                2**31,
+            ]
+        )
+        payload = b"h%d" % seq
+        b.on_data(Message.data(1, seq, len(payload), payload))
+        assert len(b._reorder) <= horizon, (
+            f"reorder buffer ballooned to {len(b._reorder)} (window {window})"
+        )
+    # Exactly the in-horizon seqs were acked; nothing beyond the horizon was
+    # (an ack would tell a *compliant* sender its data can be forgotten).
+    acked = {m.seq_num for _dst, m in chan.in_flight if m.type == MsgType.ACK}
+    assert acked == set(in_horizon)
+    # The connection still works: the in-order gap fill drains the buffer.
+    b.on_data(Message.data(1, 1, 2, b"ok"))
+    assert delivered["b"] == [b"ok"] + [b"g"] * horizon
+    assert not b._reorder
+
+
 def test_duplicate_data_acked_but_not_redelivered():
     rng = random.Random(5)
     chan, a, b, delivered = wire_pair(rng, window=4)

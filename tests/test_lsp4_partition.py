@@ -6,11 +6,14 @@ sides (the network-toggler goroutine, lsp4_test.go:113-139).  LSP's send
 buffers must hold everything written during the partition and flush it, in
 order, once the network heals:
 
+The reference's 12-scenario matrix (4 choreographies x 3 scales, up to
+5 clients x 500 msgs) is mirrored in full:
+
 - TestServerFastClose1-3 (:444-463): Close while the network is down must
   still drain once it returns.
 - TestClientToServer / TestServerToClient1-3 (:465-505): bulk streams
   written entirely during a partition arrive in order after heal.
-- TestRoundTrip1-3 (:507-526): echo traffic across repeated partitions.
+- TestRoundTrip1-3 (:507-526): buffered echo traffic across partitions.
 """
 
 import time
@@ -57,64 +60,103 @@ def collecting_server(p):
     return server, received
 
 
-def test_client_to_server_bulk_during_partition():
-    p = params()
-    server, received = collecting_server(p)
-    client = lsp.Client("127.0.0.1", server.port, p)
-    client.write(b"warm")
-    deadline = time.time() + 2
-    while not received and time.time() < deadline:
-        time.sleep(0.01)
-
-    partition(True)
-    total = 100
-    for i in range(total):
-        client.write(b"p%d" % i)
-    time.sleep(3 * EPOCH_MS / 1000)  # a few epochs of darkness
-    assert received == [b"warm"], received
-    partition(False)
-
-    want = [b"warm"] + [b"p%d" % i for i in range(total)]
-    deadline = time.time() + 50 * EPOCH_MS / 1000
-    while len(received) < len(want) and time.time() < deadline:
-        time.sleep(0.02)
-    assert received == want
-    client.close()
-    server.close()
+# The reference runs each choreography at three scales (lsp4_test.go:444-526):
+# 1 client x 10 msgs, 3 x 10, and 5 x 500 — mirrored here so the scenario
+# matrix matches the reference suite's 12 entries.
+MATRIX = [(1, 10), (3, 10), (5, 500)]
 
 
-def test_server_to_client_bulk_during_partition():
+def _warm_up_clients(server, p, n_clients):
+    """Connect n clients, learn each one's conn id via a warm-up message."""
+    clients = [lsp.Client("127.0.0.1", server.port, p) for _ in range(n_clients)]
+    cid_by_idx = {}
+    for idx, c in enumerate(clients):
+        c.write(b"warm%d" % idx)
+    for _ in range(n_clients):
+        cid, payload = server.read()
+        cid_by_idx[int(payload[4:])] = cid
+    return clients, cid_by_idx
+
+
+@pytest.mark.parametrize("n_clients,n_msgs", MATRIX)
+def test_client_to_server_bulk_during_partition(n_clients, n_msgs):
+    """Streams written entirely during a partition arrive in order after
+    heal (lsp4_test.go TestClientToServer1-3)."""
     p = params()
     server = lsp.Server(0, p)
-    client = lsp.Client("127.0.0.1", server.port, p)
-    got = []
+    clients, cid_by_idx = _warm_up_clients(server, p, n_clients)
+    received = {cid: [] for cid in cid_by_idx.values()}
 
-    def reader():
+    def collect():
         while True:
             try:
-                got.append(client.read())
+                cid, payload = server.read()
+                received[cid].append(payload)
+            except lsp.ConnLostError:
+                continue
             except lsp.LspError:
                 return
 
-    spawn(reader)
-    client.write(b"warm")
-    cid, _ = server.read()
-
+    spawn(collect)
     partition(True)
-    total = 100
-    for i in range(total):
-        server.write(cid, b"p%d" % i)
-    time.sleep(3 * EPOCH_MS / 1000)
-    assert got == [], got
+    for c in clients:
+        for i in range(n_msgs):
+            c.write(b"p%d" % i)
+    time.sleep(3 * EPOCH_MS / 1000)  # a few epochs of darkness
+    assert all(not msgs for msgs in received.values()), received
     partition(False)
 
-    want = [b"p%d" % i for i in range(total)]
-    deadline = time.time() + 50 * EPOCH_MS / 1000
-    while len(got) < total and time.time() < deadline:
+    want = [b"p%d" % i for i in range(n_msgs)]
+    deadline = time.time() + max(50, n_msgs) * EPOCH_MS / 1000
+    while (
+        any(len(m) < n_msgs for m in received.values())
+        and time.time() < deadline
+    ):
         time.sleep(0.02)
-    assert got == want
-    client.close()
+    for idx, cid in cid_by_idx.items():
+        assert received[cid] == want, f"client {idx} stream wrong"
+    for c in clients:
+        c.close()
     server.close()
+
+
+@pytest.mark.parametrize("n_clients,n_msgs", MATRIX)
+def test_server_to_client_bulk_during_partition(n_clients, n_msgs):
+    """Server streams buffered during a partition arrive in order after
+    heal (lsp4_test.go TestServerToClient1-3)."""
+    p = params()
+    server = lsp.Server(0, p)
+    clients, cid_by_idx = _warm_up_clients(server, p, n_clients)
+    got = {idx: [] for idx in range(n_clients)}
+
+    def reader(idx, c):
+        while True:
+            try:
+                got[idx].append(c.read())
+            except lsp.LspError:
+                return
+
+    readers = [spawn(lambda i=i, c=c: reader(i, c)) for i, c in enumerate(clients)]
+
+    partition(True)
+    for idx in range(n_clients):
+        for i in range(n_msgs):
+            server.write(cid_by_idx[idx], b"p%d" % i)
+    time.sleep(3 * EPOCH_MS / 1000)
+    assert all(not msgs for msgs in got.values()), got
+    partition(False)
+
+    want = [b"p%d" % i for i in range(n_msgs)]
+    deadline = time.time() + max(50, n_msgs) * EPOCH_MS / 1000
+    while any(len(m) < n_msgs for m in got.values()) and time.time() < deadline:
+        time.sleep(0.02)
+    for idx in range(n_clients):
+        assert got[idx] == want, f"client {idx} stream wrong"
+    for c in clients:
+        c.close()
+    server.close()
+    for r in readers:
+        r.join(timeout=5)
 
 
 def test_client_fast_close_flushes_after_heal():
@@ -226,6 +268,59 @@ def test_server_fast_close_three_clients():
 def test_server_fast_close_five_clients_bulk():
     # TestServerFastClose3 scale: 5 clients x 500 messages.
     _server_fast_close(5, 500)
+
+
+@pytest.mark.parametrize("n_clients,n_msgs", MATRIX)
+def test_round_trip_buffered_both_ways(n_clients, n_msgs):
+    """Buffered messages in client AND server across two partition phases
+    (lsp4_test.go TestRoundTrip1-3): clients write their whole stream into
+    a dead network; after heal the echo replies flow back; nothing leaks
+    through the partition early."""
+    p = params()
+    server = lsp.Server(0, p)
+
+    def echo_loop():
+        while True:
+            try:
+                cid, payload = server.read()
+                server.write(cid, payload)
+            except lsp.ConnLostError:
+                continue
+            except lsp.LspError:
+                return
+
+    spawn(echo_loop)
+    clients = [lsp.Client("127.0.0.1", server.port, p) for _ in range(n_clients)]
+    got = {idx: [] for idx in range(n_clients)}
+
+    def reader(idx, c):
+        while True:
+            try:
+                got[idx].append(c.read())
+            except lsp.LspError:
+                return
+
+    readers = [spawn(lambda i=i, c=c: reader(i, c)) for i, c in enumerate(clients)]
+
+    partition(True)
+    want = [b"rt%d" % i for i in range(n_msgs)]
+    for c in clients:
+        for m in want:
+            c.write(m)
+    time.sleep(3 * EPOCH_MS / 1000)
+    assert all(not msgs for msgs in got.values()), "echo leaked through partition"
+    partition(False)
+
+    deadline = time.time() + max(80, 2 * n_msgs) * EPOCH_MS / 1000
+    while any(len(m) < n_msgs for m in got.values()) and time.time() < deadline:
+        time.sleep(0.02)
+    for idx in range(n_clients):
+        assert got[idx] == want, f"client {idx} echo stream wrong"
+    for c in clients:
+        c.close()
+    server.close()
+    for r in readers:
+        r.join(timeout=5)
 
 
 def test_round_trip_across_partitions():

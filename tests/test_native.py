@@ -77,3 +77,35 @@ def test_empty_range_raises():
 def test_out_of_u64_raises():
     with pytest.raises(ValueError):
         native.min_hash_range_native("x", 0, 1 << 64)
+
+
+def test_top_of_u64_range():
+    # The two highest nonces: span arithmetic at the ceiling, 20-digit tails,
+    # and the multi-threaded clamp must all stay exact (regression for the
+    # span==0 wrap one nonce further up).
+    data, lo, hi = "ceil", (1 << 64) - 2, (1 << 64) - 1
+    want = min_hash_range(data, lo, hi)
+    assert native.min_hash_range_native(data, lo, hi) == want
+    assert native.min_hash_range_native(data, lo, hi, threads=8) == want
+
+
+def test_full_u64_range_rejected():
+    # [0, 2^64-1] wraps the u64 span to 0 (previously integer divide-by-zero
+    # UB returning (0, 0) instantly); the binding now refuses it outright.
+    with pytest.raises(ValueError, match="full 2\\^64"):
+        native.min_hash_range_native("x", 0, (1 << 64) - 1)
+
+
+def test_records_compression_path(capsys):
+    """Pin down WHICH compression path this host exercised: the plain
+    portable loop or the SHA-NI x2 interleave (sha256_sweep.cc) — so a CI
+    log shows the intricate path's coverage instead of passing silently."""
+    shani = native.have_shani()
+    with capsys.disabled():
+        print(f"\n[native] compression path: {'SHA-NI x2' if shani else 'portable'}")
+    # Either way the sweep must agree with the oracle on an even+odd span
+    # (the x2 path pairs nonces; odd remainders fall to the scalar path).
+    for lo, hi in [(10, 41), (10, 42)]:
+        assert native.min_hash_range_native("path", lo, hi) == min_hash_range(
+            "path", lo, hi
+        )

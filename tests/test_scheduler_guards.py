@@ -264,6 +264,60 @@ class TestCheckpointResume:
         s = Scheduler(resume_state=state)
         assert s.checkpoint()["jobs"] == state["jobs"]
 
+    def test_duplicate_key_entries_merge_not_overwrite(self):
+        """A live job and a staler orphaned entry for the same (data, lo, hi)
+        used to round-trip last-wins — the orphan could clobber the live
+        job's fresher progress.  They must merge: min-fold best, union
+        remaining."""
+        orphan = {
+            "data": DATA,
+            "lower": 0,
+            "upper": 299,
+            "best": [500, 42],
+            "remaining": [[0, 299]],  # stale: nothing swept yet
+        }
+        s = Scheduler(
+            min_chunk=100, max_chunk=100,
+            resume_state={"version": 1, "jobs": [orphan, dict(orphan)]},
+        )
+        # Duplicate entries within one load already collapse to one.
+        assert len(s.checkpoint()["jobs"]) == 1
+        s.miner_joined(1, now=0.0)
+        # A DIFFERENT client id resubmits; the resume entry is consumed and
+        # the job advances past the orphan's snapshot.
+        s.client_request(10, DATA, 0, 299, now=0.0)
+        h0, n0 = honest(DATA, 0, 99)
+        better = min((h0, n0), (500, 42))
+        s.result(1, h0, n0, now=1.0)  # [0,99] swept
+        # Re-stage the stale orphan AFTER the live job progressed.
+        s.load_checkpoint({"version": 1, "jobs": [orphan]})
+        state = s.checkpoint()
+        [j] = state["jobs"]
+        # best: the min of live progress and the orphan's (real) hash.
+        assert j["best"] == list(better)
+        # remaining: the union — the stale full-range claim wins space-wise
+        # (conservative re-sweep), but fresher best is never lost.
+        assert j["remaining"] == [[0, 299]]
+
+        # Round-trip into a fresh scheduler: still one entry, same content.
+        s2 = Scheduler(resume_state=state)
+        assert s2.checkpoint()["jobs"] == state["jobs"]
+
+    def test_two_identical_concurrent_jobs_checkpoint_merges(self):
+        """Two clients running the same (data, lower, upper) concurrently
+        produce one merged checkpoint entry covering both jobs' unswept
+        work and the better best."""
+        s = Scheduler(min_chunk=100, max_chunk=100)
+        s.miner_joined(1, now=0.0)
+        s.miner_joined(2, now=0.0)
+        s.client_request(10, DATA, 0, 299, now=0.0)
+        s.client_request(11, DATA, 0, 299, now=0.0)
+        h0, n0 = honest(DATA, 0, 99)
+        s.result(1, h0, n0, now=1.0)  # job 10: [0,99] swept
+        [j] = s.checkpoint()["jobs"]
+        assert j["best"] == [h0, n0]
+        assert j["remaining"] == [[0, 299]]  # job 11 still needs [0,99]
+
 
 def test_merge_intervals():
     assert _merge_intervals([]) == []
