@@ -83,15 +83,17 @@ class Watchdog:
 
 
 _PROBE = (
-    "import jax; d = jax.devices()[0]; "
-    "print('|'.join([d.platform, getattr(d, 'device_kind', '') or '']))"
+    "import jax; ds = jax.devices(); d = ds[0]; "
+    "print('|'.join([d.platform, getattr(d, 'device_kind', '') or '', "
+    "str(len(ds))]))"
 )
 
 
 def probe_accelerator(attempts: int = 2, timeout: float = 90.0):
     """Try to initialize the default (accelerator) backend in a subprocess.
 
-    Returns ``(platform, device_kind)`` on success, else ``None``.  Run in a
+    Returns ``(platform, device_kind, device_count)`` on success, else
+    ``None``.  Run in a
     child so a wedged PJRT client can be killed; retried with backoff since
     the tunnel flakes transiently.  Budget stays under ~200s worst case so a
     driver-imposed run timeout still leaves room for the CPU-fallback bench
@@ -118,13 +120,94 @@ def probe_accelerator(attempts: int = 2, timeout: float = 90.0):
             # Scan from the end: startup noise may precede the probe line.
             for line in reversed(p.stdout.strip().splitlines()):
                 if "|" in line:
-                    platform, kind = line.split("|", 1)
-                    return platform, kind
+                    fields = line.split("|")
+                    count = int(fields[2]) if len(fields) > 2 and fields[2] else 1
+                    return fields[0], fields[1], count
         lines = (p.stderr or p.stdout).strip().splitlines()
         last_err = lines[-1] if lines else "rc!=0"
         log(f"probe attempt {i + 1} failed: {last_err}")
     log(f"accelerator unavailable after {attempts} attempts: {last_err}")
     return None
+
+
+def run_sharded(args, watchdog) -> int:
+    """--devices N: bench the multi-chip sharded sweep (parallel/sweep.py)
+    over an N-device mesh.  One flag away from the near-linear-scaling
+    claim when multi-chip hardware exists; on a single-chip host it runs on
+    N virtual CPU devices so the sharding path itself is exercised."""
+    n = args.devices
+    import jax
+
+    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+    from bitcoin_miner_tpu.parallel import default_mesh, sweep_min_hash_sharded
+    from bitcoin_miner_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
+    watchdog.beat("mesh init")
+    devs = jax.devices()
+    if len(devs) < n:
+        emit({"error": f"{len(devs)} devices < requested {n}"})
+        return 1
+    platform = devs[0].platform
+    mesh = default_mesh(n)
+    log(f"sharded bench: mesh of {n} x {platform}")
+
+    def run(lo, hi, stats=None):
+        return sweep_min_hash_sharded(
+            "cmu440", lo, hi, mesh=mesh, stats=stats
+        )
+
+    # Correctness gate (digit-boundary-crossing, same as single-chip).
+    watchdog.beat("sharded correctness gate (first compile)")
+    r = run(95, 1205)
+    expect = min_hash_range("cmu440", 95, 1205)
+    if (r.hash, r.nonce) != expect:
+        emit({"error": "sharded correctness gate failed", "devices": n})
+        return 1
+    log(f"correctness OK: hash={r.hash} nonce={r.nonce}")
+
+    base = 10**9
+    run(base, base + 10**5 - 1)  # compile the timed shape class
+
+    def timed(count, stats=None):
+        watchdog.beat(f"sharded sweep of {count} nonces")
+        t0 = time.perf_counter()
+        r = run(base, base + count - 1, stats)
+        dt = time.perf_counter() - t0
+        assert r.lanes_swept == count
+        watchdog.beat()
+        return dt
+
+    count = 10**6 if platform == "cpu" else 10**8
+    dt = timed(count)
+    while dt < 4.0 and count < 4 * 10**9:
+        count = min(count * max(2, int(4.0 / max(dt, 1e-3))), 4 * 10**9)
+        dt = timed(count)
+    stats: dict = {}
+    dt = timed(count, stats)
+    watchdog.disarm()
+    rate = count / dt
+    log(
+        f"swept {count} nonces on {n} devices in {dt:.3f}s -> "
+        f"{rate:,.0f} nonces/s total, {rate / n:,.0f}/device; "
+        f"{stats['dispatches']} dispatches, "
+        f"fetch wait {stats['fetch_wait_seconds']:.3f}s"
+    )
+    emit(
+        {
+            "metric": "nonces_per_sec_total_sharded",
+            "value": round(rate),
+            "unit": "nonces/s",
+            "vs_baseline": round(rate / 1e9, 4),
+            "platform": platform,
+            "devices": n,
+            "per_device": round(rate / n),
+            "dispatches": stats["dispatches"],
+            "fetch_wait_seconds": round(stats["fetch_wait_seconds"], 3),
+            "backend": "pallas" if platform == "tpu" else "xla",
+        }
+    )
+    return 0
 
 
 def main() -> int:
@@ -155,6 +238,15 @@ def main() -> int:
         default="auto",
         help="force a tier instead of picking by platform",
     )
+    ap.add_argument(
+        "--devices",
+        type=int,
+        default=None,
+        metavar="N",
+        help="bench the sharded multi-chip sweep over an N-device mesh "
+        "(parallel/sweep.py); falls back to N virtual CPU devices when the "
+        "accelerator has fewer than N chips",
+    )
     args = ap.parse_args()
 
     warning = None
@@ -170,6 +262,37 @@ def main() -> int:
     )
     if os.environ.get("BENCH_SIMULATE_WEDGE"):  # test hook (test_bench.py)
         time.sleep(float(os.environ["BENCH_SIMULATE_WEDGE"]))
+
+    if args.devices is not None:
+        if args.devices < 1:
+            emit({"error": f"--devices must be >= 1, got {args.devices}"})
+            return 1
+        # Sharded mode is its own benchmark: the single-chip-only knobs
+        # don't apply there — say so instead of silently dropping them.
+        for flag, val in (("--autotune", args.autotune), ("--profile", args.profile)):
+            if val:
+                log(f"WARNING: {flag} is ignored in --devices sharded mode")
+        if args.backend != "auto":
+            log("WARNING: --backend is ignored in --devices sharded mode")
+        n_avail = probed[2] if probed is not None else 0
+        if n_avail < args.devices:
+            # Not enough real chips: virtual CPU mesh (the same path the
+            # driver's dryrun_multichip validates).  sitecustomize imports
+            # jax at interpreter boot, so env vars are too late — but the
+            # backends themselves initialise lazily at the first devices()
+            # call, so config.update + XLA_FLAGS still land.
+            log(
+                f"{n_avail} accelerator device(s) < {args.devices}: "
+                "benching the sharded sweep on a virtual CPU mesh"
+            )
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={args.devices}"
+            )
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        return run_sharded(args, watchdog)
 
     import jax
 
@@ -267,25 +390,38 @@ def main() -> int:
     if args.autotune and backend != "native":
         # Dispatch-shape sweep: the pallas superbatch trades dispatch
         # latency (O(100ms) on tunnelled TPUs) against per-call memory, and
-        # tile sets the VMEM blocking per grid program; measure a fixed
-        # workload at each candidate and keep the fastest.
+        # tile sets the VMEM blocking per grid program.  The probe workload
+        # must span >= 2 FULL dispatches per candidate — a sub-dispatch
+        # probe measures tunnel latency, not the kernel (the r3 autotune's
+        # numbers were 4x low and ranked candidates by overhead).  batch
+        # 2048 is known-infeasible (the 512B-padded SMEM row table caps at
+        # 1024 rows/MiB); candidates that fail to compile are skipped.
         if backend == "pallas":
             candidates = [
-                (b, t) for b in (256, 512, 1024, 2048) for t in (4096, 8192, 16384)
+                (b, t) for b in (256, 512, 1024) for t in (4096, 8192, 16384)
             ]
-            probe_n = 10**8
         else:
             candidates = [(b, None) for b in (4, 8, 16, 32)]
-            probe_n = 4 * 10**6
+        best = None
         best_rate = 0.0
         for cand_batch, cand_tile in candidates:
             tuned_batch, tuned_tile = cand_batch, cand_tile
-            timed(min(probe_n, 10**6))  # compile this shape class
-            dt = timed(probe_n)
+            lanes = 10**6 if backend == "pallas" else 10**5
+            probe_n = 2 * cand_batch * lanes
+            try:
+                timed(min(probe_n, 10**6))  # compile this shape class
+                dt = timed(probe_n)
+            except Exception as e:
+                log(f"autotune batch={cand_batch} tile={cand_tile}: "
+                    f"failed ({type(e).__name__}), skipped")
+                continue
             rate = probe_n / dt
             log(f"autotune batch={cand_batch} tile={cand_tile}: {rate:,.0f} nonces/s")
             if rate > best_rate:
                 best_rate, best = rate, (cand_batch, cand_tile)
+        if best is None:
+            emit({"error": "autotune: every candidate failed", "backend": backend})
+            return 1
         tuned_batch, tuned_tile = best
         log(f"autotune picked batch={tuned_batch} tile={tuned_tile}")
 

@@ -41,9 +41,11 @@ from .sha256 import DigitPos, compress, compress_rolled
 U32_MAX = 0xFFFFFFFF
 I32_MAX = 0x7FFFFFFF
 
-# Lanes per grid program: (tile/128, 128) uint32 vectors; 24 live values at
-# tile=8192 is ~768 KiB of VMEM-backed registers.
-DEFAULT_TILE = 8192
+# Lanes per grid program: (tile/128, 128) uint32 vectors.  4096 measured
+# best on v5e with the lane-wise accumulator (r4 on-TPU autotune:
+# 1.64e9 n/s at 4096 vs 1.58e9 at 8192 vs regressions at 16384+ from
+# vector-register spills; see BASELINE.md).
+DEFAULT_TILE = 4096
 # Chunks per dispatch (grid axis 0). 1024 chunks x 10^6 lanes ~ 1e9 nonces
 # per dispatch; SMEM footprint = batch * (n_words + 2) * 4 B.
 DEFAULT_BATCH = 1024
@@ -91,6 +93,14 @@ def make_pallas_minhash(
     I32_MAX when every lane is masked out by bounds.
     """
     n_lanes = 10**k
+    if batch * n_lanes > I32_MAX:
+        # The flat argmin index b * 10^k + i must fit int32 (Mosaic has no
+        # cheap i64); past this the kernel would return silently WRONG
+        # nonces — measured at k=7/batch=1024 before this guard existed.
+        raise ValueError(
+            f"batch ({batch}) * 10^k ({n_lanes}) lanes overflow the int32 "
+            "argmin index; lower batch or max_k"
+        )
     # Small chunks (k <= 3) fit one sub-tile; clamp tile to the padded lane
     # count so we never build a grid of empty programs.
     tile = max(1024, min(tile, math.ceil(n_lanes / 1024) * 1024))
@@ -107,18 +117,20 @@ def make_pallas_minhash(
         # combined SMEM table because SMEM pads each window row to 512 B and
         # separate template/bounds tables would exhaust the 1 MiB budget.
         contrib_refs = rest[: len(cwords)]
-        h0_ref, h1_ref, idx_ref = rest[len(cwords) :]
+        h0_ref, h1_ref, idx_ref, a0_ref, a1_ref, ai_ref = rest[len(cwords) :]
         b = pl.program_id(0)
         t = pl.program_id(1)
         lo = tailc_ref[b, n_words].astype(jnp.int32)
         hi = tailc_ref[b, n_words + 1].astype(jnp.int32)
 
-        # First program initialises the global accumulator to "no result".
+        # First program initialises the lane-wise accumulators (VMEM
+        # scratch persists across the sequential grid) to "no result".
         @pl.when((b == 0) & (t == 0))
         def _init():
-            h0_ref[0] = jnp.int32(I32_MAX)
-            h1_ref[0] = jnp.int32(I32_MAX)
-            idx_ref[0] = jnp.int32(I32_MAX)
+            empty = jnp.full((sub, 128), I32_MAX, dtype=jnp.int32)
+            a0_ref[...] = empty
+            a1_ref[...] = empty
+            ai_ref[...] = empty
 
         # Padding rows of a partial super-batch carry bounds (0, 0): skip
         # their vector work entirely with a scalar branch.
@@ -142,7 +154,13 @@ def make_pallas_minhash(
                     if widx in word_to_cidx:
                         w.append(contrib_refs[word_to_cidx[widx]][...] | base)
                     else:
-                        w.append(jnp.full((sub, 128), base, dtype=jnp.uint32))
+                        # Constant word: keep the SMEM *scalar* — compress's
+                        # lazy-broadcast grouping then runs every const-only
+                        # chain (leading rounds, K-folds, σ of const schedule
+                        # words) on the scalar unit instead of the VPU (a
+                        # fully-constant tail block costs ~4x less than a
+                        # vector one, measured on v5e).
+                        w.append(base)
                 # Mosaic wants the unrolled straight-line rounds (registers,
                 # software pipelining); interpret mode traces the kernel as
                 # plain XLA ops, where the unrolled DAG (x grid programs)
@@ -161,26 +179,39 @@ def make_pallas_minhash(
             sbit = jnp.uint32(0x80000000)
             h0b = jax.lax.bitcast_convert_type(h0 ^ sbit, jnp.int32)
             h1b = jax.lax.bitcast_convert_type(h1 ^ sbit, jnp.int32)
-            min_h0 = jnp.min(h0b)
-            e0 = h0b == min_h0
-            min_h1 = jnp.min(jnp.where(e0, h1b, jnp.int32(I32_MAX)))
-            e1 = e0 & (h1b == min_h1) & valid
             gflat = b * n_lanes + i
-            idx = jnp.min(jnp.where(e1, gflat, jnp.int32(I32_MAX)))
+            idx = jnp.where(valid, gflat, jnp.int32(I32_MAX))
 
-            # Fold this program's local min into the single global
-            # accumulator.  Grid programs execute sequentially per core, so
-            # read-modify-write of the SMEM output scalars is safe.
-            p0 = h0_ref[0]
-            p1 = h1_ref[0]
-            pi = idx_ref[0]
-            better = (min_h0 < p0) | (
-                (min_h0 == p0)
-                & ((min_h1 < p1) | ((min_h1 == p1) & (idx < pi)))
+            # Lane-wise lexicographic running min: pure compare/select, no
+            # cross-lane reduction — those cost ~2 us/program and were ~35%
+            # of kernel time (measured v5e); now they run once per DISPATCH
+            # in _final below.  Grid programs execute sequentially per core,
+            # so scratch read-modify-write is well-defined.
+            p0 = a0_ref[...]
+            p1 = a1_ref[...]
+            pi = ai_ref[...]
+            better = (h0b < p0) | (
+                (h0b == p0) & ((h1b < p1) | ((h1b == p1) & (idx < pi)))
             )
-            h0_ref[0] = jnp.where(better, min_h0, p0)
-            h1_ref[0] = jnp.where(better, min_h1, p1)
-            idx_ref[0] = jnp.where(better, idx, pi)
+            a0_ref[...] = jnp.where(better, h0b, p0)
+            a1_ref[...] = jnp.where(better, h1b, p1)
+            ai_ref[...] = jnp.where(better, idx, pi)
+
+        # Last program: one cross-lane lexicographic argmin over the
+        # accumulator tile -> the three SMEM output scalars.
+        @pl.when((b == batch - 1) & (t == n_tiles - 1))
+        def _final():
+            v0 = a0_ref[...]
+            v1 = a1_ref[...]
+            vi = ai_ref[...]
+            m0 = jnp.min(v0)
+            e0 = v0 == m0
+            m1 = jnp.min(jnp.where(e0, v1, jnp.int32(I32_MAX)))
+            e1 = e0 & (v1 == m1)
+            mi = jnp.min(jnp.where(e1, vi, jnp.int32(I32_MAX)))
+            h0_ref[0] = m0
+            h1_ref[0] = m1
+            idx_ref[0] = mi
 
     grid = (batch, n_tiles)
     in_specs = [
@@ -203,6 +234,7 @@ def make_pallas_minhash(
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((sub, 128), jnp.int32) for _ in range(3)],
         interpret=interpret,
     )
 

@@ -72,6 +72,17 @@ def compress(state: Sequence, w: Sequence) -> Tuple:
     arrays of the message block.  Returns the 8 updated state arrays.  The
     64 rounds are unrolled in Python so XLA sees one straight-line
     elementwise DAG it can fuse and software-pipeline on the VPU.
+
+    Lazy-broadcast constant folding: callers may pass *scalars* (or any
+    lower-rank shape) for message words that are constant across the lane
+    axis — per-chunk template words whose digits were folded host-side.
+    Every sub-expression whose inputs are all scalar then stays scalar
+    (Mosaic's scalar unit / XLA's (B,1) column), and the grouping below is
+    chosen so constant terms meet each other before any vector term:
+    rounds consuming only constant words run entirely off the VPU, K[t]
+    folds into constant wt for free, and σ0/σ1 of constant schedule words
+    never hit the vector lanes.  ~7% of the Pallas tier's vector ops on the
+    flagship shape (see tools/roofline.py for the op accounting).
     """
     a, b, c, d, e, f, g, h = state
     w = list(w)
@@ -83,7 +94,10 @@ def compress(state: Sequence, w: Sequence) -> Tuple:
             w2 = w[(t - 2) % 16]
             s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> jnp.uint32(3))
             s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> jnp.uint32(10))
-            wt = w[t % 16] + s0 + w[(t - 7) % 16] + s1
+            # (w[t-16] + s0) + (w[t-7] + s1): pairs each add with the term
+            # most likely to share its constness (both derive from nearby
+            # words), so constant pairs fold scalar-side.
+            wt = (w[t % 16] + s0) + (w[(t - 7) % 16] + s1)
             w[t % 16] = wt
         s1e = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         # ch/maj in their 3-op / 4-op forms (vs 4/5 naive) — ~6% of the
@@ -91,7 +105,8 @@ def compress(state: Sequence, w: Sequence) -> Tuple:
         #   ch  = (e&f) ^ (~e&g)          == g ^ (e & (f ^ g))
         #   maj = (a&b) ^ (a&c) ^ (b&c)   == b ^ ((b^a) & (b^c))
         ch = g ^ (e & (f ^ g))
-        t1 = h + s1e + ch + jnp.uint32(int(K[t])) + wt
+        # (K + wt) first: scalar-folds when wt is a constant word.
+        t1 = h + s1e + ch + (jnp.uint32(int(K[t])) + wt)
         s0a = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = b ^ ((b ^ a) & (b ^ c))
         t2 = s0a + maj

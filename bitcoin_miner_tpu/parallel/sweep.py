@@ -119,6 +119,7 @@ def sweep_min_hash_sharded(
     batch_per_device: Optional[int] = None,
     backend: Optional[str] = None,
     interpret: bool = False,
+    stats: Optional[dict] = None,
 ) -> SweepResult:
     """Multi-chip ``(min Hash(data, n), argmin n)`` over inclusive
     ``[lower, upper]``; bit-exact vs the hashlib oracle, lowest-nonce ties.
@@ -127,6 +128,10 @@ def sweep_min_hash_sharded(
     (padded rows have empty lane bounds and are masked in-kernel).  Results
     are fetched lazily after all dispatches are queued so the device
     pipeline stays full.
+
+    ``stats``, if given, is filled with dispatch-overlap accounting:
+    ``dispatches`` (count), ``fetch_wait_seconds`` (host time blocked on
+    result fetches — near zero means enqueue fully overlapped compute).
     """
     if mesh is None:
         mesh = default_mesh(axis_name=axis_name)
@@ -155,7 +160,12 @@ def sweep_min_hash_sharded(
             rolled,
         )
 
+    if stats is not None:
+        stats.update(dispatches=0, fetch_wait_seconds=0.0)
+
     def run_kernel(kern, midstate, tail_const, bounds):
+        if stats is not None:
+            stats["dispatches"] += 1
         return kern(
             jax.device_put(midstate, rep_sharding),
             jax.device_put(tail_const, row_sharding),
@@ -166,6 +176,12 @@ def sweep_min_hash_sharded(
 
     def consume(out, bases, n_lanes):
         h0, h1, dev, flat = out
+        if stats is not None:
+            import time
+
+            t0 = time.perf_counter()
+            jax.block_until_ready(flat)
+            stats["fetch_wait_seconds"] += time.perf_counter() - t0
         fi = int(flat)
         if fi == I32_MAX:
             return

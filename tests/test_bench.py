@@ -28,6 +28,22 @@ def run_bench(*flags, env=None, timeout=560):
     )
 
 
+def test_sharded_devices_mode_on_virtual_mesh():
+    """--devices N must run the sharded sweep on a virtual CPU mesh when
+    there aren't N real chips, and report per-device + overlap stats."""
+    p = run_bench("--devices", "2", "--cpu")
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = p.stdout.strip().splitlines()
+    assert len(lines) == 1, lines
+    out = json.loads(lines[0])
+    assert out["metric"] == "nonces_per_sec_total_sharded"
+    assert out["devices"] == 2
+    assert out["value"] > 0
+    assert out["per_device"] == round(out["value"] / 2)
+    assert out["dispatches"] >= 1
+    assert "fetch_wait_seconds" in out
+
+
 def test_post_probe_wedge_still_emits_json():
     """If the in-process backend init hangs AFTER the subprocess probe (the
     tunnel wedging between probe and jax.devices), the watchdog must still

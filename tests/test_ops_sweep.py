@@ -129,3 +129,41 @@ class TestPallasTier:
             batch=2, max_k=2, tile=2048,
         )
         assert (r.hash, r.nonce) == min_hash_range("abc", 95, 321)
+
+    def test_argmin_index_overflow_rejected(self):
+        # batch * 10^k beyond int32 would silently corrupt the flat argmin
+        # index (measured wrong nonces at k=7/batch=1024 on TPU) — the
+        # kernel builder must refuse the shape outright.
+        from bitcoin_miner_tpu.ops.pallas_sha256 import make_pallas_minhash
+        from bitcoin_miner_tpu.ops.sha256 import build_layout
+
+        layout = build_layout(b"cmu440", 10)
+        with pytest.raises(ValueError, match="int32"):
+            make_pallas_minhash(
+                layout.n_tail_blocks, layout.digit_pos[3:], 7, batch=1024
+            )
+
+    def test_tie_break_same_dispatch_lowest_nonce(self):
+        # Two chunk rows covering the SAME nonce range in one dispatch tie
+        # on (h0, h1) everywhere; the lane accumulator + final cross-lane
+        # argmin must resolve to the lowest flat index -> lowest nonce.
+        from bitcoin_miner_tpu.ops.pallas_sha256 import make_pallas_minhash
+        from bitcoin_miner_tpu.ops.sha256 import build_layout
+        import numpy as np
+
+        layout = build_layout(b"tie", 3)
+        k = 2
+        fn = make_pallas_minhash(
+            layout.n_tail_blocks, layout.digit_pos[1:], k,
+            batch=2, interpret=True,
+        )
+        midstate = np.array(layout.midstate, dtype=np.uint32)
+        row = np.array(layout.tail_template, dtype=np.uint64)
+        dp = layout.digit_pos[0]
+        row[dp.word] |= np.uint64(ord("1") << dp.shift)  # high digit '1'
+        tailcb = np.tile(
+            np.concatenate([row, [0, 100]]).astype(np.uint32), (2, 1)
+        )
+        h0, h1, idx = fn(midstate, tailcb)
+        # Both rows are nonces [100, 199]; the winner must come from row 0.
+        assert int(idx) < 10**k

@@ -1,0 +1,160 @@
+"""Empirical VPU roofline for the Pallas SHA-256 sweep (VERDICT r3 item 2).
+
+The sweep kernel is pure elementwise uint32 work on the VPU (the MXU is
+useless for SHA — SURVEY §7 hard-part 2), so its ceiling is the chip's
+sustained u32 ALU rate, not FLOPs or HBM.  This tool measures that rate
+with a Pallas kernel whose op mix mirrors one SHA round — serially
+dependent chains of shift/or/xor/add over 8 independent state registers
+(the a..h analogue, the same ILP the real kernel exposes) — and divides by
+the real kernel's op count to print the nonces/s ceiling.
+
+Static op accounting of the real kernel (ops/pallas_sha256.py, one tail
+block, k in-kernel digits):
+
+  per round t=0..63:   s1e 11 + ch 3 + t1 4 + s0a 11 + maj 4 + t2 1
+                       + e-add 1 + a-add 1                    = 36 ops
+  schedule t=16..63:   s0 9 + s1 9 + 3 adds                   = 21 ops
+  epilogue/assembly:   state add 8 + w-OR/broadcast ~16
+                       + mask/min reduction ~16               ~ 40 ops
+
+  -> 64*36 + 48*21 + 40 = 3352 u32 ops/nonce  (x tail blocks)
+
+Usage: python tools/roofline.py   (on the TPU; prints one JSON line)
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+OPS_PER_NONCE_1BLOCK = 64 * 36 + 48 * 21 + 40  # see module docstring
+
+# One probe iteration = 8 parallel chains x 8 ops (shl, shr, or, xor, add,
+# shl, shr, or) — the rotr+mix micro-pattern; each chain serially dependent
+# like the SHA state recurrence.
+OPS_PER_ITER = 8 * 8
+
+
+@functools.lru_cache(maxsize=4)
+def _make_probe(n_iters: int, tile: int, grid: int):
+    sub = tile // 128
+
+    def kernel(seed_ref, out_ref):
+        # 8 independent serial chains, like SHA's a..h registers.  The
+        # program id feeds every chain — without it all grid programs are
+        # byte-identical (constant index maps, no id dependence) and the
+        # compiler collapses the grid to one program's work.
+        pid = pl.program_id(0).astype(jnp.uint32)
+        # Every element distinct (row and column iota): a sublane-uniform
+        # tensor gets a replicated Mosaic layout and is computed on one
+        # sublane — 64x less work than the probe claims.
+        lane = jax.lax.broadcasted_iota(
+            jnp.uint32, (sub, 128), 0
+        ) * jnp.uint32(131) + jax.lax.broadcasted_iota(jnp.uint32, (sub, 128), 1)
+        s = tuple(
+            jnp.full((sub, 128), seed_ref[i] + pid, dtype=jnp.uint32) + lane
+            for i in range(8)
+        )
+
+        def rot_mix(x, c):
+            r = (x << jnp.uint32(13)) | (x >> jnp.uint32(19))  # 3 ops
+            x = (x ^ r) + c                                    # 2 ops
+            return (x << jnp.uint32(7)) | (x >> jnp.uint32(25))  # 3 ops
+
+        # 64 iterations unrolled per loop trip: the real kernel is one
+        # straight-line 64-round block, and Mosaic only reaches peak issue
+        # rate on unrolled code — a tiny fori_loop body measures loop
+        # overhead, not the VPU (6x low on this chip).
+        UNROLL = 64
+        assert n_iters % UNROLL == 0
+
+        def body(t, s):
+            c = t.astype(jnp.uint32)
+            for u in range(UNROLL):
+                cu = c + jnp.uint32(u * 8)
+                s = tuple(rot_mix(x, cu + jnp.uint32(i)) for i, x in enumerate(s))
+            return s
+
+        s = jax.lax.fori_loop(0, n_iters // UNROLL, body, s)
+        acc = s[0]
+        for x in s[1:]:
+            acc = acc ^ x
+        # Mosaic has no unsigned reductions; reduce in the int32 bitcast.
+        # Accumulate across programs (grid programs run sequentially, like
+        # the real kernel's SMEM min-fold) — a plain overwrite would leave
+        # every program but the last dead and free to be skipped.
+        local = jnp.max(jax.lax.bitcast_convert_type(acc, jnp.int32))
+
+        @pl.when(pid == 0)
+        def _init():
+            out_ref[0] = local
+
+        @pl.when(pid != 0)
+        def _fold():
+            out_ref[0] = out_ref[0] ^ local
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
+    )
+    return jax.jit(lambda seed: call(seed))
+
+
+def measure_peak(n_iters: int = 8192, tile: int = 8192, grid: int = 1024):
+    """Sustained u32 elementwise ops/s with the SHA-like mix.
+
+    Every call gets a DISTINCT seed: the tunnelled TPU backend returns
+    cached results for byte-identical (executable, args) re-executions, so
+    repeating one input measures RPC latency, not compute.  Per-call work
+    is sized ~1 s so the ~15 ms dispatch overhead is noise.
+    """
+    probe = _make_probe(n_iters, tile, grid)
+    probe(jnp.arange(8, dtype=jnp.uint32))[0].block_until_ready()  # compile
+    reps = 3
+    seeds = [
+        jnp.arange(8, dtype=jnp.uint32) + jnp.uint32(1 + r) for r in range(reps)
+    ]
+    t0 = time.perf_counter()
+    for s in seeds:
+        out = probe(s)
+    out[0].block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    total_ops = grid * tile * n_iters * OPS_PER_ITER
+    return total_ops / dt, dt
+
+
+def main() -> int:
+    dev = jax.devices()[0]
+    ops_per_s, dt = measure_peak()
+    ceiling = ops_per_s / OPS_PER_NONCE_1BLOCK
+    print(
+        f"device={dev.device_kind or dev.platform}  probe {dt * 1e3:.1f} ms"
+        f"  sustained {ops_per_s / 1e12:.2f} T u32-ops/s",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "vpu_u32_ops_per_sec",
+                "value": round(ops_per_s),
+                "ops_per_nonce": OPS_PER_NONCE_1BLOCK,
+                "nonces_per_sec_ceiling": round(ceiling),
+                "device_kind": getattr(dev, "device_kind", "") or dev.platform,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
