@@ -178,13 +178,14 @@ def run_sharded(args, watchdog) -> int:
         watchdog.beat()
         return dt
 
+    # stats resets on every sweep entry, so the last iteration's numbers
+    # are the ones reported — no extra stats-only sweep needed.
+    stats: dict = {}
     count = 10**6 if platform == "cpu" else 10**8
-    dt = timed(count)
+    dt = timed(count, stats)
     while dt < 4.0 and count < 4 * 10**9:
         count = min(count * max(2, int(4.0 / max(dt, 1e-3))), 4 * 10**9)
-        dt = timed(count)
-    stats: dict = {}
-    dt = timed(count, stats)
+        dt = timed(count, stats)
     watchdog.disarm()
     rate = count / dt
     log(
@@ -402,11 +403,15 @@ def main() -> int:
             ]
         else:
             candidates = [(b, None) for b in (4, 8, 16, 32)]
+        from bitcoin_miner_tpu.ops.sweep import auto_tune
+
+        # Lanes-per-chunk from the tier's own max_k default, so the
+        # two-full-dispatches probe sizing can't drift out of sync with it.
+        lanes = 10 ** auto_tune(backend, None, None)[2]
         best = None
         best_rate = 0.0
         for cand_batch, cand_tile in candidates:
             tuned_batch, tuned_tile = cand_batch, cand_tile
-            lanes = 10**6 if backend == "pallas" else 10**5
             probe_n = 2 * cand_batch * lanes
             try:
                 timed(min(probe_n, 10**6))  # compile this shape class

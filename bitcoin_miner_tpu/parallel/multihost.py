@@ -24,10 +24,11 @@ Only host 0 opens the LSP connection to the scheduler; the others run the
 same jitted computation via XLA's SPMD launch (standard multi-controller
 JAX: every process executes the same program on its local devices).
 
-Single-host environments can't exercise this path; it is kept thin and
-structurally identical to the single-host sharded sweep so the CPU-mesh
-tests of parallel/sweep.py cover the program logic, and only the
-`jax.distributed.initialize` wiring is environment-specific.
+The full wiring — `jax.distributed.initialize` over a loopback
+coordinator, the cross-process global mesh, the host-0 broadcast, and the
+sharded sweep across processes — executes in
+tests/test_multihost_distributed.py as a real two-process CPU job; on TPU
+pods only the device type changes.
 """
 
 from __future__ import annotations

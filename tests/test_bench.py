@@ -39,7 +39,8 @@ def test_sharded_devices_mode_on_virtual_mesh():
     assert out["metric"] == "nonces_per_sec_total_sharded"
     assert out["devices"] == 2
     assert out["value"] > 0
-    assert out["per_device"] == round(out["value"] / 2)
+    # value and per_device are rounded independently from the raw rate.
+    assert abs(out["per_device"] - out["value"] / 2) <= 1
     assert out["dispatches"] >= 1
     assert "fetch_wait_seconds" in out
 

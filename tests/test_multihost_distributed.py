@@ -1,0 +1,98 @@
+"""The jax.distributed multi-host path, actually executed.
+
+tests/test_multihost.py covers the broadcast protocol single-process; this
+spawns TWO real processes that join one ``jax.distributed`` job over a
+loopback coordinator (CPU backend, one device per process), build the
+global mesh, broadcast a Request host-0-to-all, and run the sharded sweep
+over the cross-process mesh — the exact wiring
+``apps/miner.py --multihost`` uses on a TPU pod (run_miner_multihost),
+which previously never executed anywhere (VERDICT r3 item 25).
+"""
+
+import json
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+WORKER = r"""
+import json, sys
+import numpy as np
+import jax
+from jax.experimental import multihost_utils
+
+from bitcoin_miner_tpu.parallel import multihost, sweep_min_hash_sharded
+
+host_id, port = int(sys.argv[1]), sys.argv[2]
+multihost.initialize(f"127.0.0.1:{port}", 2, host_id)
+assert jax.process_count() == 2, jax.process_count()
+assert multihost.is_primary() == (host_id == 0)
+mesh = multihost.global_mesh()
+assert mesh.devices.size == 2, mesh  # one CPU device per process
+
+# Host 0 owns the Request; everyone gets it via the collective broadcast
+# (serve_multihost's loop body, apps/miner.py).
+buf = (
+    multihost.encode_request("mh", 95, 1999)
+    if multihost.is_primary()
+    else multihost.encode_shutdown()
+)
+req = multihost.decode_request(np.asarray(multihost_utils.broadcast_one_to_all(buf)))
+assert req == ("mh", 95, 1999), req
+
+r = sweep_min_hash_sharded(req[0], req[1], req[2], mesh=mesh, max_k=2)
+if multihost.is_primary():
+    print(json.dumps({"hash": r.hash, "nonce": r.nonce}), flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.timeout(180)
+def test_two_process_distributed_sweep(tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    import os
+
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO),
+        # One plain CPU device per process: drop the 8-virtual-device
+        # XLA_FLAGS the test session itself runs under (conftest.py).
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+            cwd=REPO,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=150)
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(out)
+
+    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+
+    result = json.loads(outs[0].strip().splitlines()[-1])
+    want_hash, want_nonce = min_hash_range("mh", 95, 1999)
+    assert (result["hash"], result["nonce"]) == (want_hash, want_nonce)
+    # Secondary host emits no Result (only host 0 owns the LSP side);
+    # runtime chatter like Gloo's connection line is fine.
+    assert not [l for l in outs[1].splitlines() if l.startswith("{")]
