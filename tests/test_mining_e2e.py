@@ -407,7 +407,9 @@ def test_adversarial_fleet_soak():
         for t in ths:
             t.start()
         for t in ths:
-            t.join(timeout=120)
+            # Generous: this host exposes one CPU core, and a full-suite
+            # run adds contention on top of the 20% loss + 8-miner fleet.
+            t.join(timeout=240)
             assert not t.is_alive(), "client starved"
         for data, mx in jobs:
             assert results[data] == min_hash_range(data, 0, mx), data
