@@ -1,155 +1,124 @@
-"""Empirical VPU roofline for the Pallas SHA-256 sweep (VERDICT r3 item 2).
+"""Empirical VPU roofline for the Pallas SHA-256 sweep.
 
-The sweep kernel is pure elementwise uint32 work on the VPU (the MXU is
-useless for SHA — SURVEY §7 hard-part 2), so its ceiling is the chip's
-sustained u32 ALU rate, not FLOPs or HBM.  This tool measures that rate
-with a Pallas kernel whose op mix mirrors one SHA round — serially
-dependent chains of shift/or/xor/add over 8 independent state registers
-(the a..h analogue, the same ILP the real kernel exposes) — and divides by
-the real kernel's op count to print the nonces/s ceiling.
+The sweep is pure elementwise uint32 work on the VPU (the MXU is useless
+for SHA — SURVEY §7 hard-part 2), so its ceiling is the chip's sustained
+u32 ALU rate, not FLOPs or HBM.  This tool measures that rate **in situ
+with the production kernel**, by comparing sweeps whose tails have one vs
+two vector compression blocks: the marginal cost of the extra block
+isolates pure compression time from per-program overhead (epilogue,
+masking, window DMA, grid bookkeeping).
 
-Static op accounting of the real kernel (ops/pallas_sha256.py, one tail
+Why not a synthetic micro-kernel: two environment facts defeat that
+approach here, both discovered the hard way —
+
+1. the tunnelled TPU backend returns cached results for byte-identical
+   (executable, args) re-executions, so repeated identical dispatches
+   measure RPC latency, not compute;
+2. Mosaic's layout inference collapses work it can prove redundant:
+   grid programs with no program_id dependence dedupe, and sublane-
+   replicated tensors compute on one sublane — a naive probe quietly
+   loses 64-1000x of its claimed work.
+
+Static op accounting of the kernel (ops/pallas_sha256.py, per tail
 block, k in-kernel digits):
 
   per round t=0..63:   s1e 11 + ch 3 + t1 4 + s0a 11 + maj 4 + t2 1
                        + e-add 1 + a-add 1                    = 36 ops
   schedule t=16..63:   s0 9 + s1 9 + 3 adds                   = 21 ops
-  epilogue/assembly:   state add 8 + w-OR/broadcast ~16
-                       + mask/min reduction ~16               ~ 40 ops
+  state add + w assembly + mask/accumulate                    ~ 40 ops
 
-  -> 64*36 + 48*21 + 40 = 3352 u32 ops/nonce  (x tail blocks)
+  -> ~3,350 u32 vector ops/nonce per vector block BEFORE constant-word
+     folding (const-only chains run on the scalar unit and don't count
+     against the VPU).
+
+The derived figures are BOUNDS, not point estimates, because the marginal
+block is partially scalar-folded itself (for DATA_2BLK only word 15 of
+block 0 varies, so that block's leading rounds and most const-σ schedule
+chains are scalar) and streams one fewer contrib tile than the 1-block
+layout.  The marginal cost c therefore UNDERprices a full vector block:
+
+  - 1/c            = UPPER bound on the 1-block nonces/s ceiling
+                     (=> headroom <= 1/c / rate_1blk - 1)
+  - OPS_PER_BLOCK/c = UPPER bound on sustained vector u32 ops/s
+                     (the marginal block executes fewer than
+                     OPS_PER_BLOCK vector ops)
 
 Usage: python tools/roofline.py   (on the TPU; prints one JSON line)
 """
 
 from __future__ import annotations
 
-import functools
 import json
 import sys
 import time
+from pathlib import Path
 
-import jax
-import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
 
-OPS_PER_NONCE_1BLOCK = 64 * 36 + 48 * 21 + 40  # see module docstring
+OPS_PER_BLOCK = 64 * 36 + 48 * 21 + 40  # see module docstring
 
-# One probe iteration = 8 parallel chains x 8 ops (shl, shr, or, xor, add,
-# shl, shr, or) — the rotr+mix micro-pattern; each chain serially dependent
-# like the SHA state recurrence.
-OPS_PER_ITER = 8 * 8
-
-
-@functools.lru_cache(maxsize=4)
-def _make_probe(n_iters: int, tile: int, grid: int):
-    sub = tile // 128
-
-    def kernel(seed_ref, out_ref):
-        # 8 independent serial chains, like SHA's a..h registers.  The
-        # program id feeds every chain — without it all grid programs are
-        # byte-identical (constant index maps, no id dependence) and the
-        # compiler collapses the grid to one program's work.
-        pid = pl.program_id(0).astype(jnp.uint32)
-        # Every element distinct (row and column iota): a sublane-uniform
-        # tensor gets a replicated Mosaic layout and is computed on one
-        # sublane — 64x less work than the probe claims.
-        lane = jax.lax.broadcasted_iota(
-            jnp.uint32, (sub, 128), 0
-        ) * jnp.uint32(131) + jax.lax.broadcasted_iota(jnp.uint32, (sub, 128), 1)
-        s = tuple(
-            jnp.full((sub, 128), seed_ref[i] + pid, dtype=jnp.uint32) + lane
-            for i in range(8)
-        )
-
-        def rot_mix(x, c):
-            r = (x << jnp.uint32(13)) | (x >> jnp.uint32(19))  # 3 ops
-            x = (x ^ r) + c                                    # 2 ops
-            return (x << jnp.uint32(7)) | (x >> jnp.uint32(25))  # 3 ops
-
-        # 64 iterations unrolled per loop trip: the real kernel is one
-        # straight-line 64-round block, and Mosaic only reaches peak issue
-        # rate on unrolled code — a tiny fori_loop body measures loop
-        # overhead, not the VPU (6x low on this chip).
-        UNROLL = 64
-        assert n_iters % UNROLL == 0
-
-        def body(t, s):
-            c = t.astype(jnp.uint32)
-            for u in range(UNROLL):
-                cu = c + jnp.uint32(u * 8)
-                s = tuple(rot_mix(x, cu + jnp.uint32(i)) for i, x in enumerate(s))
-            return s
-
-        s = jax.lax.fori_loop(0, n_iters // UNROLL, body, s)
-        acc = s[0]
-        for x in s[1:]:
-            acc = acc ^ x
-        # Mosaic has no unsigned reductions; reduce in the int32 bitcast.
-        # Accumulate across programs (grid programs run sequentially, like
-        # the real kernel's SMEM min-fold) — a plain overwrite would leave
-        # every program but the last dead and free to be skipped.
-        local = jnp.max(jax.lax.bitcast_convert_type(acc, jnp.int32))
-
-        @pl.when(pid == 0)
-        def _init():
-            out_ref[0] = local
-
-        @pl.when(pid != 0)
-        def _fold():
-            out_ref[0] = out_ref[0] ^ local
-
-    call = pl.pallas_call(
-        kernel,
-        grid=(grid,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
-        out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
-    )
-    return jax.jit(lambda seed: call(seed))
+# Tail shapes for 10-digit nonces (base 1e9): 'cmu440' -> 1 vector block;
+# 'y'*57 -> c_len 58, digits at bytes 58..68, low-6 digits straddle words
+# 15/16 -> BOTH tail blocks carry vector words (a 60-byte prefix would
+# leave block 0 fully constant => scalar-unit, measuring nothing).
+DATA_1BLK = "cmu440"
+DATA_2BLK = "y" * 57
 
 
-def measure_peak(n_iters: int = 8192, tile: int = 8192, grid: int = 1024):
-    """Sustained u32 elementwise ops/s with the SHA-like mix.
+def _rate(data: str, n: int) -> float:
+    from bitcoin_miner_tpu.ops.sweep import sweep_min_hash
 
-    Every call gets a DISTINCT seed: the tunnelled TPU backend returns
-    cached results for byte-identical (executable, args) re-executions, so
-    repeating one input measures RPC latency, not compute.  Per-call work
-    is sized ~1 s so the ~15 ms dispatch overhead is noise.
-    """
-    probe = _make_probe(n_iters, tile, grid)
-    probe(jnp.arange(8, dtype=jnp.uint32))[0].block_until_ready()  # compile
-    reps = 3
-    seeds = [
-        jnp.arange(8, dtype=jnp.uint32) + jnp.uint32(1 + r) for r in range(reps)
-    ]
+    base = 10**9
+    sweep_min_hash(data, base, base + 10**6 - 1)  # compile
     t0 = time.perf_counter()
-    for s in seeds:
-        out = probe(s)
-    out[0].block_until_ready()
-    dt = (time.perf_counter() - t0) / reps
-    total_ops = grid * tile * n_iters * OPS_PER_ITER
-    return total_ops / dt, dt
+    r = sweep_min_hash(data, base, base + n - 1)
+    dt = time.perf_counter() - t0
+    assert r.lanes_swept == n
+    return n / dt
 
 
 def main() -> int:
+    import jax
+
+    from bitcoin_miner_tpu.ops.sha256 import build_layout
+
+    lay2 = build_layout(DATA_2BLK.encode(), 10)
+    assert lay2.n_tail_blocks == 2
+    # Both blocks must carry low-digit words or block 0 folds to scalars.
+    low_words = {p.word for p in lay2.digit_pos[4:]}
+    assert min(low_words) < 16 <= max(low_words), low_words
+
     dev = jax.devices()[0]
-    ops_per_s, dt = measure_peak()
-    ceiling = ops_per_s / OPS_PER_NONCE_1BLOCK
+    kind = (getattr(dev, "device_kind", "") or dev.platform)
+    n = 2 * 10**9
+    r1 = _rate(DATA_1BLK, n)
+    r2 = _rate(DATA_2BLK, n)
+    # t = n * (blocks * c + o): the marginal block isolates c — a LOWER
+    # bound on a full vector block's cost (see module docstring).
+    c = 1 / r2 - 1 / r1  # seconds per nonce per (marginal) block
+    sustained_ub = OPS_PER_BLOCK / c
+    ceiling_ub = 1 / c
+    headroom_ub = ceiling_ub / r1 - 1
     print(
-        f"device={dev.device_kind or dev.platform}  probe {dt * 1e3:.1f} ms"
-        f"  sustained {ops_per_s / 1e12:.2f} T u32-ops/s",
+        f"device={kind}  "
+        f"1blk {r1 / 1e9:.2f}e9 n/s  2blk {r2 / 1e9:.2f}e9 n/s  "
+        f"marginal block {c * 1e9:.3f} ns -> <= {sustained_ub / 1e12:.1f} T "
+        f"u32-ops/s sustained; 1blk ceiling <= {ceiling_ub / 1e9:.2f}e9 n/s "
+        f"(headroom over current rate <= {headroom_ub:.0%})",
         file=sys.stderr,
     )
     print(
         json.dumps(
             {
-                "metric": "vpu_u32_ops_per_sec",
-                "value": round(ops_per_s),
-                "ops_per_nonce": OPS_PER_NONCE_1BLOCK,
-                "nonces_per_sec_ceiling": round(ceiling),
-                "device_kind": getattr(dev, "device_kind", "") or dev.platform,
+                "metric": "vpu_u32_ops_per_sec_sustained_upper_bound",
+                "value": round(sustained_ub),
+                "ops_per_block_unfolded": OPS_PER_BLOCK,
+                "rate_1blk": round(r1),
+                "rate_2blk": round(r2),
+                "marginal_block_ns": round(c * 1e9, 4),
+                "ceiling_1blk_upper_bound": round(ceiling_ub),
+                "headroom_upper_bound": round(headroom_ub, 4),
+                "device_kind": kind,
             }
         )
     )
