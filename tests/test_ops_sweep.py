@@ -130,6 +130,42 @@ class TestPallasTier:
         )
         assert (r.hash, r.nonce) == min_hash_range("abc", 95, 321)
 
+    def test_digit_words_straddle_tail_blocks(self):
+        # 61-byte data + 3-digit nonces: digit bytes 62..64 span words
+        # 15 (block 0) and 16 (block 1) — both tail blocks carry vector
+        # words, the layout class where constant-folding must not leak.
+        data = "s" * 61
+        lay = build_layout(data.encode(), 3)
+        words = {p.word for p in lay.digit_pos[1:]}  # k=2 low digits
+        assert min(words) < 16 <= max(words), words
+        r = sweep_min_hash(
+            data, 100, 460, backend="pallas", interpret=True, batch=2, max_k=2
+        )
+        assert (r.hash, r.nonce) == min_hash_range(data, 100, 460)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_fuzz_data_lengths_and_ranges(self, seed):
+        """Seeded fuzz over data lengths x range positions, specifically
+        sampling shapes where the in-kernel digit words STRADDLE a tail
+        block boundary (e.g. 57-byte data, 10-digit nonces -> words 15/16)
+        — the layout class where both blocks carry vector words and the
+        scalar constant-folding must not leak across blocks."""
+        import random
+
+        rng = random.Random(seed)
+        for _ in range(4):
+            dlen = rng.choice([0, 3, 54, 55, 56, 57, 58, 60, 61, 120])
+            data = "f" * dlen
+            d = rng.choice([2, 3])  # digit counts (k <= 2 keeps compiles fast)
+            lo = rng.randint(10 ** (d - 1), 10**d - 30)
+            hi = min(lo + rng.randint(1, 150), 10**d - 1)
+            r = sweep_min_hash(
+                data, lo, hi, backend="pallas", interpret=True, batch=2, max_k=2
+            )
+            assert (r.hash, r.nonce) == min_hash_range(data, lo, hi), (
+                dlen, d, lo, hi,
+            )
+
     def test_argmin_index_overflow_rejected(self):
         # batch * 10^k beyond int32 would silently corrupt the flat argmin
         # index (measured wrong nonces at k=7/batch=1024 on TPU) — the

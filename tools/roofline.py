@@ -65,13 +65,16 @@ DATA_1BLK = "cmu440"
 DATA_2BLK = "y" * 57
 
 
+MAX_K = 6  # explicit: the measurement premise below depends on it
+
+
 def _rate(data: str, n: int) -> float:
     from bitcoin_miner_tpu.ops.sweep import sweep_min_hash
 
     base = 10**9
-    sweep_min_hash(data, base, base + 10**6 - 1)  # compile
+    sweep_min_hash(data, base, base + 10**6 - 1, max_k=MAX_K)  # compile
     t0 = time.perf_counter()
-    r = sweep_min_hash(data, base, base + n - 1)
+    r = sweep_min_hash(data, base, base + n - 1, max_k=MAX_K)
     dt = time.perf_counter() - t0
     assert r.lanes_swept == n
     return n / dt
@@ -82,10 +85,11 @@ def main() -> int:
 
     from bitcoin_miner_tpu.ops.sha256 import build_layout
 
+    assert build_layout(DATA_1BLK.encode(), 10).n_tail_blocks == 1
     lay2 = build_layout(DATA_2BLK.encode(), 10)
     assert lay2.n_tail_blocks == 2
     # Both blocks must carry low-digit words or block 0 folds to scalars.
-    low_words = {p.word for p in lay2.digit_pos[4:]}
+    low_words = {p.word for p in lay2.digit_pos[lay2.digit_count - MAX_K :]}
     assert min(low_words) < 16 <= max(low_words), low_words
 
     dev = jax.devices()[0]
@@ -96,6 +100,9 @@ def main() -> int:
     # t = n * (blocks * c + o): the marginal block isolates c — a LOWER
     # bound on a full vector block's cost (see module docstring).
     c = 1 / r2 - 1 / r1  # seconds per nonce per (marginal) block
+    # A non-positive marginal means a degenerate measurement (e.g. the
+    # dispatch-caching hazard above) — refuse to publish nonsense bounds.
+    assert c > 0, (r1, r2)
     sustained_ub = OPS_PER_BLOCK / c
     ceiling_ub = 1 / c
     headroom_ub = ceiling_ub / r1 - 1
