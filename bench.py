@@ -394,12 +394,12 @@ def main() -> int:
         # tile sets the VMEM blocking per grid program.  The probe workload
         # must span >= 2 FULL dispatches per candidate — a sub-dispatch
         # probe measures tunnel latency, not the kernel (the r3 autotune's
-        # numbers were 4x low and ranked candidates by overhead).  batch
-        # 2048 is known-infeasible (the 512B-padded SMEM row table caps at
-        # 1024 rows/MiB); candidates that fail to compile are skipped.
+        # numbers were 4x low and ranked candidates by overhead).
+        # Candidates that fail to compile are skipped (batch 2048 needs the
+        # flattened SMEM chunk table; the int32 argmin guard caps larger).
         if backend == "pallas":
             candidates = [
-                (b, t) for b in (256, 512, 1024) for t in (4096, 8192, 16384)
+                (b, t) for b in (512, 1024, 2048) for t in (2048, 4096, 8192)
             ]
         else:
             candidates = [(b, None) for b in (4, 8, 16, 32)]

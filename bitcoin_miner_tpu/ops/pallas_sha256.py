@@ -4,11 +4,14 @@ Why Pallas: the jnp tier's unrolled 64-round graph does not stay fused on
 TPU — XLA materialises (B, N) uint32 intermediates to HBM between fusions,
 capping throughput at ~2e7 nonce/s.  Here each grid program hashes a tile of
 lanes entirely in VMEM/vector registers: inputs are a handful of scalar
-template words (SMEM) plus the precomputed low-digit ASCII contribution
-tiles (VMEM, ~12 B/nonce streamed), and the *entire grid* accumulates one
-global running minimum into three SMEM scalars — TPU grid programs run
-sequentially on the core, so cross-program read-modify-write of the output
-ref is well-defined.  The hot loop never touches HBM.
+template words (SMEM, table flattened to dodge 512B row padding) plus the
+precomputed low-digit ASCII contribution tiles (VMEM, ~12 B/nonce
+streamed).  Each program folds a *lane-wise* lexicographic running min
+into VMEM scratch — pure compare/select, no cross-lane reduction (those
+cost ~2 us/program and were ~35% of kernel time) — and the final program
+does one cross-lane argmin into three SMEM output scalars.  TPU grid
+programs run sequentially per core, so cross-program read-modify-write of
+scratch is well-defined.  The hot loop never touches HBM.
 
 Dispatch-count matters as much as kernel speed: on remote-tunnelled TPUs a
 dispatch + result fetch costs O(100 ms), so a call processes a *super-batch*
@@ -112,16 +115,21 @@ def make_pallas_minhash(
 
     n_words = n_tail_blocks * 16
 
+    row_w = n_words + 2  # words per chunk row: template + lo_off + hi_off
+
     def kernel(midstate_ref, tailc_ref, *rest):
-        # tailc_ref row layout: [word_0 .. word_{nw-1}, lo_off, hi_off] — one
-        # combined SMEM table because SMEM pads each window row to 512 B and
-        # separate template/bounds tables would exhaust the 1 MiB budget.
+        # tailc_ref is the chunk table FLATTENED to 1-D, logical row layout
+        # [word_0 .. word_{nw-1}, lo_off, hi_off]: SMEM pads every row of a
+        # 2-D window to 512 B — (1024, 18) ate 512 KiB of the 1 MiB budget
+        # and (2048, 18) overflowed it outright — while the 1-D form is
+        # ~4 B/word (147 KiB at batch 2048).
         contrib_refs = rest[: len(cwords)]
         h0_ref, h1_ref, idx_ref, a0_ref, a1_ref, ai_ref = rest[len(cwords) :]
         b = pl.program_id(0)
         t = pl.program_id(1)
-        lo = tailc_ref[b, n_words].astype(jnp.int32)
-        hi = tailc_ref[b, n_words + 1].astype(jnp.int32)
+        base_off = b * row_w
+        lo = tailc_ref[base_off + n_words].astype(jnp.int32)
+        hi = tailc_ref[base_off + n_words + 1].astype(jnp.int32)
 
         # First program initialises the lane-wise accumulators (VMEM
         # scratch persists across the sequential grid) to "no result".
@@ -150,7 +158,7 @@ def make_pallas_minhash(
             for blk in range(n_tail_blocks):
                 w = []
                 for widx in range(blk * 16, (blk + 1) * 16):
-                    base = tailc_ref[b, widx]
+                    base = tailc_ref[base_off + widx]
                     if widx in word_to_cidx:
                         w.append(contrib_refs[word_to_cidx[widx]][...] | base)
                     else:
@@ -216,7 +224,7 @@ def make_pallas_minhash(
     grid = (batch, n_tiles)
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # midstate (8,)
-        pl.BlockSpec(memory_space=pltpu.SMEM),  # tail_const+bounds (B, nw+2)
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # tail_const+bounds, flat (B*(nw+2),)
     ] + [
         pl.BlockSpec((sub, 128), lambda b, t: (t, 0), memory_space=pltpu.VMEM)
         for _ in cwords
@@ -243,7 +251,7 @@ def make_pallas_minhash(
         contribs = tuple(
             jnp.asarray(c) for c in _digit_contrib_np(k, low_pos, n_pad)
         )
-        h0b, h1b, idx = call(midstate, tailc_bounds, *contribs)
+        h0b, h1b, idx = call(midstate, tailc_bounds.reshape(-1), *contribs)
         sbit = jnp.uint32(0x80000000)
         min_h0 = jax.lax.bitcast_convert_type(h0b[0], jnp.uint32) ^ sbit
         min_h1 = jax.lax.bitcast_convert_type(h1b[0], jnp.uint32) ^ sbit
