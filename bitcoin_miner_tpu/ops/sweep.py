@@ -289,6 +289,7 @@ def sweep_min_hash(
     max_k: Optional[int] = None,
     batch: Optional[int] = None,
     tile: Optional[int] = None,
+    cpb: Optional[int] = None,
     backend: Optional[str] = None,
     interpret: bool = False,
 ) -> SweepResult:
@@ -321,6 +322,7 @@ def sweep_min_hash(
                 batch,
                 tile=tile if tile is not None else DEFAULT_TILE,
                 interpret=interpret,
+                cpb=cpb,  # None = largest divisor of batch up to the default
             )
         return _make_kernel(layout.n_tail_blocks, low_pos, group.k, batch, rolled)
 

@@ -130,6 +130,44 @@ class TestPallasTier:
         )
         assert (r.hash, r.nonce) == min_hash_range("abc", 95, 321)
 
+    def test_group_fold_multiple_chunks_per_program(self):
+        # batch=4 with cpb=2: two chunk rows fold inside each grid program
+        # (the per-group running-min path), and a range that doesn't fill
+        # all rows leaves a MIXED group whose padding row must mask out.
+        r = sweep_min_hash(
+            "abc", 95, 321, backend="pallas", interpret=True,
+            batch=4, cpb=2, max_k=2,
+        )
+        assert (r.hash, r.nonce) == min_hash_range("abc", 95, 321)
+
+    def test_group_fold_tie_breaks_to_lowest_nonce(self):
+        # Duplicate rows covering the same range tie on (h0, h1) in the
+        # SAME program's group fold; the winner must be the lower row.
+        from bitcoin_miner_tpu.ops.pallas_sha256 import make_pallas_minhash
+        import numpy as np
+
+        layout = build_layout(b"tie", 3)
+        fn = make_pallas_minhash(
+            layout.n_tail_blocks, layout.digit_pos[1:], 2,
+            batch=2, cpb=2, interpret=True,
+        )
+        midstate = np.array(layout.midstate, dtype=np.uint32)
+        row = np.array(layout.tail_template, dtype=np.uint64)
+        dp = layout.digit_pos[0]
+        row[dp.word] |= np.uint64(ord("1") << dp.shift)
+        tailcb = np.tile(
+            np.concatenate([row, [0, 100]]).astype(np.uint32), (2, 1)
+        )
+        _h0, _h1, idx = fn(midstate, tailcb)
+        assert int(idx) < 100  # row 0, not the duplicate row 1
+
+    def test_non_divisor_cpb_rejected(self):
+        with pytest.raises(ValueError, match="cpb"):
+            sweep_min_hash(
+                "abc", 95, 99, backend="pallas", interpret=True,
+                batch=4, cpb=3, max_k=2,
+            )
+
     def test_digit_words_straddle_tail_blocks(self):
         # 61-byte data + 3-digit nonces: digit bytes 62..64 span words
         # 15 (block 0) and 16 (block 1) — both tail blocks carry vector
