@@ -306,6 +306,8 @@ def sweep_min_hash(
     TPUs is O(100 ms), so the pallas tier defaults to a large super-batch
     (~1e9 nonces/dispatch); padding rows are skipped in-kernel.
     ``tile`` = lanes per pallas grid program (VMEM blocking; pallas only).
+    ``cpb`` = chunk rows per pallas grid program (amortises per-program
+    fixed cost; must divide ``batch``; None = largest divisor up to 8).
     """
     backend, batch, max_k = auto_tune(backend, batch, max_k)
     rolled = not is_tpu()
