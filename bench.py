@@ -334,6 +334,7 @@ def main() -> int:
 
     tuned_batch = None  # None = the tier's default chunks-per-dispatch
     tuned_tile = None  # None = the pallas tier's default lanes-per-program
+    tuned_cpb = None  # None = the pallas tier's default chunk rows/program
 
     def run(d: str, lo: int, hi: int, max_k=None):
         if backend == "native":
@@ -341,7 +342,7 @@ def main() -> int:
             return h, n, hi - lo + 1
         r = sweep_min_hash(
             d, lo, hi, backend=backend, max_k=max_k,
-            batch=tuned_batch, tile=tuned_tile,
+            batch=tuned_batch, tile=tuned_tile, cpb=tuned_cpb,
         )
         return r.hash, r.nonce, r.lanes_swept
 
@@ -399,10 +400,13 @@ def main() -> int:
         # flattened SMEM chunk table; the int32 argmin guard caps larger).
         if backend == "pallas":
             candidates = [
-                (b, t) for b in (512, 1024, 2048) for t in (2048, 4096, 8192)
+                (b, t, c)
+                for b in (1024, 2048)
+                for t in (2048, 4096, 8192)
+                for c in (4, 8)
             ]
         else:
-            candidates = [(b, None) for b in (4, 8, 16, 32)]
+            candidates = [(b, None, None) for b in (4, 8, 16, 32)]
         from bitcoin_miner_tpu.ops.sweep import auto_tune
 
         # Lanes-per-chunk from the tier's own max_k default, so the
@@ -410,25 +414,30 @@ def main() -> int:
         lanes = 10 ** auto_tune(backend, None, None)[2]
         best = None
         best_rate = 0.0
-        for cand_batch, cand_tile in candidates:
-            tuned_batch, tuned_tile = cand_batch, cand_tile
-            probe_n = 2 * cand_batch * lanes
+        for cand in candidates:
+            tuned_batch, tuned_tile, tuned_cpb = cand
+            probe_n = 2 * cand[0] * lanes
             try:
                 timed(min(probe_n, 10**6))  # compile this shape class
                 dt = timed(probe_n)
             except Exception as e:
-                log(f"autotune batch={cand_batch} tile={cand_tile}: "
-                    f"failed ({type(e).__name__}), skipped")
+                log(f"autotune {cand}: failed ({type(e).__name__}), skipped")
                 continue
             rate = probe_n / dt
-            log(f"autotune batch={cand_batch} tile={cand_tile}: {rate:,.0f} nonces/s")
+            log(
+                f"autotune batch={cand[0]} tile={cand[1]} cpb={cand[2]}: "
+                f"{rate:,.0f} nonces/s"
+            )
             if rate > best_rate:
-                best_rate, best = rate, (cand_batch, cand_tile)
+                best_rate, best = rate, cand
         if best is None:
             emit({"error": "autotune: every candidate failed", "backend": backend})
             return 1
-        tuned_batch, tuned_tile = best
-        log(f"autotune picked batch={tuned_batch} tile={tuned_tile}")
+        tuned_batch, tuned_tile, tuned_cpb = best
+        log(
+            f"autotune picked batch={tuned_batch} tile={tuned_tile} "
+            f"cpb={tuned_cpb}"
+        )
 
     n = 4 * 10**6
     dt = timed(n)
@@ -457,6 +466,8 @@ def main() -> int:
         out["batch"] = tuned_batch
     if tuned_tile is not None:
         out["tile"] = tuned_tile
+    if tuned_cpb is not None:
+        out["cpb"] = tuned_cpb
     if warning:
         out["warning"] = warning
     emit(out)
