@@ -200,15 +200,21 @@ def make_pallas_minhash(
                             # constant tail block costs ~4x less than a
                             # vector one, measured on v5e).
                             w.append(base)
+                    # The reduction reads only (h0, h1): the last block's
+                    # compression drops the work feeding the 6 dead digest
+                    # words (final_only).
+                    last = blk == n_tail_blocks - 1
                     # Mosaic wants the unrolled straight-line rounds
                     # (registers, software pipelining); interpret mode
                     # traces the kernel as plain XLA ops, where the
                     # unrolled DAG (x grid programs) sends XLA:CPU into
                     # minutes-long LLVM compiles — roll it.
                     if interpret:
-                        state = compress_rolled(state, w, k_table=k_table)
+                        state = compress_rolled(
+                            state, w, k_table=k_table, final_only=last
+                        )
                     else:
-                        state = compress(state, w)
+                        state = compress(state, w, final_only=last)
 
                 valid = (i >= los[j]) & (i < his[j])
                 h0 = jnp.where(valid, state[0], jnp.uint32(U32_MAX))

@@ -65,13 +65,20 @@ def _rotr(x, n: int):
     return (x >> n) | (x << (jnp.uint32(32) - n))
 
 
-def compress(state: Sequence, w: Sequence) -> Tuple:
+def compress(state: Sequence, w: Sequence, final_only: bool = False) -> Tuple:
     """One SHA-256 compression of a 16-word block.
 
     ``state``: 8 uint32 arrays (any broadcastable shape); ``w``: 16 uint32
     arrays of the message block.  Returns the 8 updated state arrays.  The
     64 rounds are unrolled in Python so XLA sees one straight-line
     elementwise DAG it can fuse and software-pipeline on the VPU.
+
+    ``final_only=True`` (for a message's LAST block when only the first 8
+    digest bytes matter — the mining contract reads exactly ``(h0, h1)``,
+    reference ``bitcoin/hash.go:16``): returns just ``(out_a, out_b)`` and
+    skips the work feeding only the 6 dead outputs — round 63's ``e``-add
+    and 6 of the 8 final state additions (every other round op feeds the
+    live pair transitively, so this is all the dead code there is).
 
     Lazy-broadcast constant folding: callers may pass *scalars* (or any
     lower-rank shape) for message words that are constant across the lane
@@ -86,6 +93,11 @@ def compress(state: Sequence, w: Sequence) -> Tuple:
     """
     a, b, c, d, e, f, g, h = state
     w = list(w)
+    # maj cross-round reuse: b_t ^ c_t == a_{t-1} ^ b_{t-1} (the state
+    # shuffle renames, it doesn't recompute), so each round's (b^c) is last
+    # round's (a^b) — carried in prev_xab.  Saves 1 op/round vs the 4-op
+    # form; spelled explicitly rather than trusting commutative CSE.
+    prev_xab = b ^ c
     for t in range(64):
         if t < 16:
             wt = w[t]
@@ -100,23 +112,30 @@ def compress(state: Sequence, w: Sequence) -> Tuple:
             wt = (w[t % 16] + s0) + (w[(t - 7) % 16] + s1)
             w[t % 16] = wt
         s1e = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-        # ch/maj in their 3-op / 4-op forms (vs 4/5 naive) — ~6% of the
+        # ch/maj in their 3-op / 3-op forms (vs 4/5 naive) — ~8% of the
         # kernel's total vector ops at 64 rounds:
         #   ch  = (e&f) ^ (~e&g)          == g ^ (e & (f ^ g))
-        #   maj = (a&b) ^ (a&c) ^ (b&c)   == b ^ ((b^a) & (b^c))
+        #   maj = (a&b) ^ (a&c) ^ (b&c)   == b ^ ((b^a) & (b^c)),
+        #         with (b^c) reused from last round's (a^b)
         ch = g ^ (e & (f ^ g))
         # (K + wt) first: scalar-folds when wt is a constant word.
         t1 = h + s1e + ch + (jnp.uint32(int(K[t])) + wt)
         s0a = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-        maj = b ^ ((b ^ a) & (b ^ c))
+        xab = b ^ a
+        maj = b ^ (xab & prev_xab)
+        prev_xab = xab
         t2 = s0a + maj
+        if final_only and t == 63:
+            return ((t1 + t2) + state[0], a + state[1])
         h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
     s = (a, b, c, d, e, f, g, h)
     init = (state[0], state[1], state[2], state[3], state[4], state[5], state[6], state[7])
     return tuple(x + y for x, y in zip(s, init))
 
 
-def compress_rolled(state: Sequence, w: Sequence, k_table=None) -> Tuple:
+def compress_rolled(
+    state: Sequence, w: Sequence, k_table=None, final_only: bool = False
+) -> Tuple:
     """One SHA-256 compression with the 64 rounds as ``lax.fori_loop``s.
 
     Same contract as :func:`compress`, different compilation shape: the
@@ -172,6 +191,8 @@ def compress_rolled(state: Sequence, w: Sequence, k_table=None) -> Tuple:
 
     st, wbuf = lax.fori_loop(0, 16, lambda t, c: phase1(t, c), (st0, wbuf))
     st, _ = lax.fori_loop(16, 64, lambda t, c: phase2(t, c), (st, wbuf))
+    if final_only:  # same contract as compress(final_only=True): (a, b) only
+        return (st[0] + st0[0], st[1] + st0[1])
     return tuple(x + y for x, y in zip(st, st0))
 
 

@@ -142,7 +142,9 @@ def make_kernel_body(
                     w.append(col | contrib[widx][None, :])  # (B, N)
                 else:
                     w.append(col)
-            state = comp(state, w)
+            # Last block: only (h0, h1) survive into the reduction, so skip
+            # the dead digest words (compress final_only).
+            state = comp(state, w, final_only=(b == n_tail_blocks - 1))
         h0 = jnp.broadcast_to(state[0], (batch, n_lanes))
         h1 = jnp.broadcast_to(state[1], (batch, n_lanes))
 
