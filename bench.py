@@ -76,8 +76,14 @@ class Watchdog:
             idle = time.monotonic() - self._last
             if idle > self.timeout:
                 log(f"WATCHDOG: '{self.stage}' hung {idle:.0f}s; aborting")
-                emit({"error": f"{self.stage} hung >{self.timeout:.0f}s"})
-                sys.stdout.flush()
+                # Single os.write (atomic for short writes), NOT print():
+                # if the main thread is mid-emit on a slow pipe, interleaved
+                # writes would break the one-valid-JSON-line contract.  The
+                # leading newline terminates any partial main-thread line.
+                err = json.dumps(
+                    {"error": f"{self.stage} hung >{self.timeout:.0f}s"}
+                )
+                os.write(sys.stdout.fileno(), f"\n{err}\n".encode())
                 sys.stderr.flush()
                 os._exit(2)
 
@@ -441,9 +447,12 @@ def main() -> int:
 
     n = 4 * 10**6
     dt = timed(n)
-    # Grow until the measurement window is solid (caps at ~4e9 nonces).
-    while dt < 4.0 and n < 4 * 10**9:
-        n = min(n * max(2, int(4.0 / max(dt, 1e-3))), 4 * 10**9)
+    # Grow until the measurement window is solid (caps at ~8e9 nonces; at
+    # ~1.85e9 n/s the fixed lead-in dispatch + trailing fetch through the
+    # tunnel is ~45 ms, so a 4e9 window under-reports steady state by ~2%
+    # and 8e9 by ~1%).
+    while dt < 4.0 and n < 8 * 10**9:
+        n = min(n * max(2, int(4.0 / max(dt, 1e-3))), 8 * 10**9)
         dt = timed(n)
     if args.profile:
         with jax.profiler.trace(args.profile):

@@ -19,29 +19,30 @@ approach here, both discovered the hard way —
    replicated tensors compute on one sublane — a naive probe quietly
    loses 64-1000x of its claimed work.
 
-Static op accounting of the kernel (ops/pallas_sha256.py, per tail
-block, k in-kernel digits):
+**Exact folded op counts** (r5, replacing the r4 upper-bound handwave):
+the kernel's constant-word folding keeps every all-scalar sub-expression
+off the VPU (ops/sha256.py `compress` docstring), so the static count
+that matters is the number of ops with at least one *vector* input.  That
+is computed here exactly, by abstract interpretation: `compress` is run
+on tracer values carrying only a scalar/vector flag, counting each op
+whose result is vector, for the exact word layout of the measured data
+shapes.  With both measured rates and both exact counts, the marginal-
+block algebra yields a *point estimate* of sustained VPU throughput, not
+a bound:
 
-  per round t=0..63:   s1e 11 + ch 3 + t1 4 + s0a 11 + maj 4 + t2 1
-                       + e-add 1 + a-add 1                    = 36 ops
-  schedule t=16..63:   s0 9 + s1 9 + 3 adds                   = 21 ops
-  state add + w assembly + mask/accumulate                    ~ 40 ops
+    1/r1 = (ops1 + EPI)/S + o        (o = per-nonce non-ALU overhead:
+    1/r2 = (ops2 + EPI)/S + o         grid/DMA/bookkeeping, identical for
+                                      both shapes — same batch/tile/cpb/k)
+    =>  S = (ops2 - ops1) / (1/r2 - 1/r1)
 
-  -> ~3,350 u32 vector ops/nonce per vector block BEFORE constant-word
-     folding (const-only chains run on the scalar unit and don't count
-     against the VPU).
+and the compute-only ceiling of the flagship shape is
 
-The derived figures are BOUNDS, not point estimates, because the marginal
-block is partially scalar-folded itself (for DATA_2BLK only word 15 of
-block 0 varies, so that block's leading rounds and most const-σ schedule
-chains are scalar) and streams one fewer contrib tile than the 1-block
-layout.  The marginal cost c therefore UNDERprices a full vector block:
+    ceiling = S / (ops1 + EPI)        (reached iff o -> 0).
 
-  - 1/c            = UPPER bound on the 1-block nonces/s ceiling
-                     (=> headroom <= 1/c / rate_1blk - 1)
-  - OPS_PER_BLOCK/c = UPPER bound on sustained vector u32 ops/s
-                     (the marginal block executes fewer than
-                     OPS_PER_BLOCK vector ops)
+The model's fidelity caveat: "scalar stays scalar" mirrors Mosaic's lazy
+broadcast, but Mosaic's own CSE may trim a few more ops and register
+pressure may add spill traffic the count can't see; treat the ceiling as
+good to a few percent, which is enough to size the remaining headroom.
 
 Usage: python tools/roofline.py   (on the TPU; prints one JSON line)
 """
@@ -55,17 +56,88 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
 
-OPS_PER_BLOCK = 64 * 36 + 48 * 21 + 40  # see module docstring
-
-# Tail shapes for 10-digit nonces (base 1e9): 'cmu440' -> 1 vector block;
-# 'y'*57 -> c_len 58, digits at bytes 58..68, low-6 digits straddle words
-# 15/16 -> BOTH tail blocks carry vector words (a 60-byte prefix would
-# leave block 0 fully constant => scalar-unit, measuring nothing).
+# Tail shapes for 10-digit nonces (base 1e9): 'cmu440' -> 1 vector block,
+# low-6 digits at bytes 11..16 -> contrib words {2,3,4}; 'y'*54 -> c_len 55,
+# digits at bytes 55..64, low-6 digits at bytes 59..64 -> contrib words
+# {14,15,16}: BOTH tail blocks carry vector words (a 60-byte prefix would
+# leave block 0 fully constant => scalar-unit, measuring nothing) AND both
+# shapes stream exactly THREE contrib VMEM windows per program, so the
+# per-program overhead o really is identical between the two measurements
+# (a 2-contrib-word probe like 'y'*57 would fold a small window-streaming
+# asymmetry into the marginal).
 DATA_1BLK = "cmu440"
-DATA_2BLK = "y" * 57
+DATA_2BLK = "y" * 54
 
 
 MAX_K = 6  # explicit: the measurement premise below depends on it
+
+from bitcoin_miner_tpu.ops.pallas_sha256 import DEFAULT_CPB as CPB  # noqa: E402
+
+# Per-nonce VPU ops of the kernel OUTSIDE compress, hand-counted from
+# ops/pallas_sha256.py's kernel body (per row-visit per lane):
+#   valid mask        2 cmp + 1 and                  = 3
+#   h0/h1 select      2 where                        = 2
+#   sign-flip         2 xor (bitcast is layout-free) = 2
+#   idx               1 add + 1 where                = 2
+#   running-min fold  9 cmp/and/or + 3 where = 12, skipped on the first
+#                     of the cpb rows                = 12 * (CPB-1)/CPB
+# amortised once per program over cpb rows:
+#   lane index i      ~5 (2 iota + mul + 2 add)      = 5 / CPB
+#   accumulator RMW   12                             = 12 / CPB
+EPILOGUE_OPS = 3 + 2 + 2 + 2 + 12 * (CPB - 1) / CPB + (5 + 12) / CPB
+
+
+class _Tr:
+    """Abstract value for the folded-op count: tracks only vectorness."""
+
+    __array_ufunc__ = None  # make numpy scalars defer to our reflected ops
+    __slots__ = ("vec",)
+
+    def __init__(self, vec: bool) -> None:
+        self.vec = vec
+
+
+_COUNT = [0]
+
+
+def _op(*xs):
+    vec = any(isinstance(x, _Tr) and x.vec for x in xs)
+    if vec:
+        _COUNT[0] += 1  # result is vector => one VPU op
+    return _Tr(vec)
+
+
+for _name in ("add", "xor", "and", "or"):
+    setattr(_Tr, f"__{_name}__", lambda self, o: _op(self, o))
+    setattr(_Tr, f"__r{_name}__", lambda self, o: _op(self, o))
+for _name in ("lshift", "rshift"):
+    setattr(_Tr, f"__{_name}__", lambda self, o: _op(self, o))
+
+
+def count_vector_ops(data: str, d: int, k: int) -> int:
+    """Exact VPU op count per nonce for one full tail hash of ``data`` at
+    digit count ``d`` with ``k`` in-kernel digits: the contrib-word ORs of
+    the kernel's w assembly plus every vector op inside each block's
+    `compress` (final block in final_only form), threading the state's
+    vectorness across blocks exactly as the kernel does."""
+    from bitcoin_miner_tpu.ops.sha256 import build_layout, compress
+
+    layout = build_layout(data.encode(), d)
+    cwords = {p.word for p in layout.digit_pos[layout.digit_count - k :]}
+    state = tuple(_Tr(False) for _ in range(8))  # midstate scalars
+    total = 0
+    for b in range(layout.n_tail_blocks):
+        w = []
+        for widx in range(b * 16, (b + 1) * 16):
+            if widx in cwords:
+                total += 1  # the contrib | base assembly OR
+                w.append(_Tr(True))
+            else:
+                w.append(_Tr(False))
+        _COUNT[0] = 0
+        state = compress(state, w, final_only=(b == layout.n_tail_blocks - 1))
+        total += _COUNT[0]
+    return total
 
 
 def _rate(data: str, n: int) -> float:
@@ -92,39 +164,43 @@ def main() -> int:
     low_words = {p.word for p in lay2.digit_pos[lay2.digit_count - MAX_K :]}
     assert min(low_words) < 16 <= max(low_words), low_words
 
+    ops1 = count_vector_ops(DATA_1BLK, 10, MAX_K)
+    ops2 = count_vector_ops(DATA_2BLK, 10, MAX_K)
+
     dev = jax.devices()[0]
     kind = (getattr(dev, "device_kind", "") or dev.platform)
     n = 2 * 10**9
     r1 = _rate(DATA_1BLK, n)
     r2 = _rate(DATA_2BLK, n)
-    # t = n * (blocks * c + o): the marginal block isolates c — a LOWER
-    # bound on a full vector block's cost (see module docstring).
-    c = 1 / r2 - 1 / r1  # seconds per nonce per (marginal) block
+    c = 1 / r2 - 1 / r1  # seconds per nonce for the marginal (ops2-ops1)
     # A non-positive marginal means a degenerate measurement (e.g. the
-    # dispatch-caching hazard above) — refuse to publish nonsense bounds.
+    # dispatch-caching hazard above) — refuse to publish nonsense numbers.
     assert c > 0, (r1, r2)
-    sustained_ub = OPS_PER_BLOCK / c
-    ceiling_ub = 1 / c
-    headroom_ub = ceiling_ub / r1 - 1
+    sustained = (ops2 - ops1) / c
+    ceiling = sustained / (ops1 + EPILOGUE_OPS)
+    headroom = ceiling / r1 - 1
     print(
-        f"device={kind}  "
-        f"1blk {r1 / 1e9:.2f}e9 n/s  2blk {r2 / 1e9:.2f}e9 n/s  "
-        f"marginal block {c * 1e9:.3f} ns -> <= {sustained_ub / 1e12:.1f} T "
-        f"u32-ops/s sustained; 1blk ceiling <= {ceiling_ub / 1e9:.2f}e9 n/s "
-        f"(headroom over current rate <= {headroom_ub:.0%})",
+        f"device={kind}  exact folded ops: 1blk {ops1} + {EPILOGUE_OPS:.1f} "
+        f"epilogue, 2blk {ops2} (marginal {ops2 - ops1})\n"
+        f"1blk {r1 / 1e9:.3f}e9 n/s  2blk {r2 / 1e9:.3f}e9 n/s  "
+        f"marginal {c * 1e9:.3f} ns -> sustained {sustained / 1e12:.2f} T "
+        f"u32-ops/s; 1blk compute ceiling {ceiling / 1e9:.2f}e9 n/s "
+        f"(headroom over current rate {headroom:.0%})",
         file=sys.stderr,
     )
     print(
         json.dumps(
             {
-                "metric": "vpu_u32_ops_per_sec_sustained_upper_bound",
-                "value": round(sustained_ub),
-                "ops_per_block_unfolded": OPS_PER_BLOCK,
+                "metric": "vpu_u32_ops_per_sec_sustained",
+                "value": round(sustained),
+                "ops_1blk": ops1,
+                "ops_2blk": ops2,
+                "epilogue_ops": round(EPILOGUE_OPS, 2),
                 "rate_1blk": round(r1),
                 "rate_2blk": round(r2),
-                "marginal_block_ns": round(c * 1e9, 4),
-                "ceiling_1blk_upper_bound": round(ceiling_ub),
-                "headroom_upper_bound": round(headroom_ub, 4),
+                "marginal_ns": round(c * 1e9, 4),
+                "ceiling_1blk": round(ceiling),
+                "headroom": round(headroom, 4),
                 "device_kind": kind,
             }
         )
