@@ -83,30 +83,144 @@ def make_search(backend: str = "auto", devices: Optional[int] = None) -> SearchF
     return search
 
 
-def run_miner(client: "lsp.Client", search: SearchFn) -> None:
+class _PoolSearch:
+    """Async facade over a blocking search fn: one worker thread, so
+    completion order == submission order (the scheduler matches FIFO).
+    Used for the cpu/native tier, the sharded mesh search, and plain
+    callables handed to :func:`run_miner` by tests."""
+
+    def __init__(self, fn: SearchFn) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._fn = fn
+        self._pool = ThreadPoolExecutor(max_workers=1)
+
+    def submit(self, data: str, lower: int, upper: int):
+        return self._pool.submit(self._fn, data, lower, upper)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+class _PipelineSearch:
+    """Async facade over :class:`ops.sweep.SweepPipeline` (the JAX tiers):
+    dispatches of the NEXT chunk enqueue on the device while the current
+    chunk computes, so back-to-back Requests cost zero device idle."""
+
+    def __init__(self, backend: Optional[str]) -> None:
+        from concurrent.futures import Future
+
+        from ..ops.sweep import SweepPipeline
+
+        self._Future = Future
+        self._p = SweepPipeline(backend=backend)
+
+    def submit(self, data: str, lower: int, upper: int):
+        out = self._Future()
+
+        def _done(src) -> None:
+            e = src.exception()
+            if e is not None:
+                out.set_exception(e)
+            else:
+                r = src.result()
+                out.set_result((r.hash, r.nonce))
+
+        self._p.submit(data, lower, upper).add_done_callback(_done)
+        return out
+
+    def close(self) -> None:
+        self._p.close()
+
+
+def make_async_search(backend: str = "auto", devices: Optional[int] = None):
+    """Build the async (submit -> Future of (hash, nonce)) search the miner
+    serves Requests with.  JAX single-device tiers get the cross-request
+    SweepPipeline; the cpu tier and the sharded mesh search run behind a
+    single-worker pool (FIFO, compute-bound anyway)."""
+    if backend == "cpu" or (devices is not None and devices != 1):
+        return _PoolSearch(make_search(backend, devices))
+    if backend == "auto":
+        from ..utils.platform import is_tpu
+
+        if not is_tpu():
+            return _PoolSearch(make_search("cpu"))
+        backend = None  # ops layer picks pallas-on-TPU
+    from ..utils.platform import enable_compile_cache
+
+    enable_compile_cache()
+    return _PipelineSearch(backend)
+
+
+def run_miner(client: "lsp.Client", search) -> None:
     """Join and serve Requests until the server connection dies (the
-    reference miner's intended lifetime: exit on server loss)."""
+    reference miner's intended lifetime: exit on server loss).
+
+    ``search`` is either a plain ``(data, lo, hi) -> (hash, nonce)``
+    callable (wrapped in a one-worker pool) or an async object with
+    ``submit(data, lo, hi) -> Future`` (see :func:`make_async_search`).
+    Requests are read by a dedicated thread and submitted immediately;
+    Results are written in submission (FIFO) order, matching the
+    scheduler's pipelined FIFO accounting.  Why: one synchronous sweep
+    pays ~0.2 s of dispatch+fetch latency on a tunnelled TPU, so with the
+    scheduler's 2-deep assignment window the NEXT chunk's dispatches must
+    enqueue while the current chunk computes — a serialized request loop
+    caps the fleet at ~25% of kernel rate (measured r5,
+    tools/fleet_bench.py).
+    """
+    import queue as _queue
+    import threading
+
+    owned = not hasattr(search, "submit")
+    asearch = _PoolSearch(search) if owned else search
     client.write(Message.join().marshal())
-    while True:
-        try:
-            payload = client.read()
-        except lsp.LspError:
-            return  # server lost/closed → miner exits
-        msg = Message.unmarshal(payload)
-        if msg is None or msg.type != MsgType.REQUEST:
-            continue
-        try:
-            h, n = search(msg.data, msg.lower, msg.upper)
-        except Exception as e:
-            # A broken backend (e.g. pallas without a TPU) must not dump a
-            # traceback mid-protocol; exit cleanly so the server reassigns.
-            print(f"miner: search failed: {e!r}", file=sys.stderr)
-            return
-        METRICS.inc("miner.nonces", msg.upper - msg.lower + 1)
-        try:
-            client.write(Message.result(h, n).marshal())
-        except lsp.LspError:
-            return
+    inflight: "_queue.Queue" = _queue.Queue()
+
+    def reader() -> None:
+        while True:
+            try:
+                payload = client.read()
+            except lsp.LspError:
+                inflight.put(None)  # server lost/closed → drain and exit
+                return
+            msg = Message.unmarshal(payload)
+            if msg is None or msg.type != MsgType.REQUEST:
+                continue
+            try:
+                inflight.put(
+                    (asearch.submit(msg.data, msg.lower, msg.upper), msg)
+                )
+            except Exception:
+                # Search closed under us (main loop exiting): a Request
+                # racing the shutdown must not traceback this thread.
+                inflight.put(None)
+                return
+
+    t = threading.Thread(target=reader, name="miner-reader", daemon=True)
+    t.start()
+    try:
+        while True:
+            item = inflight.get()
+            if item is None:
+                return
+            fut, msg = item
+            try:
+                h, n = fut.result()
+            except Exception as e:
+                # A broken backend (e.g. pallas without a TPU) must not dump
+                # a traceback mid-protocol; exit cleanly so the server
+                # reassigns.
+                print(f"miner: search failed: {e!r}", file=sys.stderr)
+                return
+            METRICS.inc("miner.nonces", msg.upper - msg.lower + 1)
+            try:
+                client.write(Message.result(h, n).marshal())
+            except lsp.LspError:
+                return
+    finally:
+        # Don't block on an in-flight sweep (it may be wedged — that's why
+        # we're exiting); daemon threads are reaped with the process.
+        asearch.close()
 
 
 def serve_multihost(client, sweep: SearchFn, broadcast) -> None:
@@ -218,10 +332,42 @@ def main(argv=None) -> int:
         )
         return 0
     try:
-        search = make_search(args.backend, args.devices)
+        search = make_async_search(args.backend, args.devices)
     except ValueError as e:
         print("Invalid miner configuration:", e)
         return 0
+    import os
+    import time as _time
+
+    if os.environ.get("BMT_MINER_LOG"):
+        # Operator observability: per-chunk submit/resolve timing on stderr
+        # (used by tools/fleet_bench.py --miner-log to audit fleet cadence).
+        _t0 = _time.monotonic()
+        _inner = search
+
+        class _LoggedSearch:
+            def submit(self, d, lo, hi):
+                t = _time.monotonic() - _t0
+                print(
+                    f"{t:9.3f} submit [{lo},{hi}] size={hi - lo + 1:.3e}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                f = _inner.submit(d, lo, hi)
+                f.add_done_callback(
+                    lambda _s, lo=lo, hi=hi, t=t: print(
+                        f"{_time.monotonic() - _t0:9.3f} done   [{lo},{hi}] "
+                        f"dt={_time.monotonic() - _t0 - t:.3f}",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                )
+                return f
+
+            def close(self):
+                _inner.close()
+
+        search = _LoggedSearch()
     host, _, port = args.hostport.rpartition(":")
     try:
         client = lsp.Client(host or "127.0.0.1", int(port))

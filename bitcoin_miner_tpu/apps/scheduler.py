@@ -18,7 +18,19 @@ Design notes (deliberately not a translation of anything):
   CPU.  Jobs keep *interval* work lists (not pre-cut chunks); each
   assignment carves a chunk sized to the miner's EWMA nonces/sec so every
   chunk targets ``target_chunk_seconds`` of work.  New miners start at
-  ``min_chunk`` and ramp as rates are observed.
+  ``min_chunk`` and ramp as rates are observed; a geometric boost
+  (``ramp_factor``× the last chunk while chunks complete in under half the
+  target) shortens the cold ramp from ~15 round-trips to ~6.
+- **Pipelined assignment** (``pipeline_depth``, default 2): each miner
+  holds up to depth outstanding chunks, results matched FIFO (LSP delivers
+  in order and the miner processes in order).  Why: on tunnelled TPUs one
+  synchronous sweep pays ~0.2 s of dispatch+fetch latency per chunk — a
+  serialized one-chunk-per-miner loop equilibrates at ~25% of kernel rate
+  (measured r5, tools/fleet_bench.py); with a second chunk queued at the
+  miner, the next sweep's dispatches enqueue while the current computes
+  and the latency vanishes.  Rate samples use the result-to-result gap
+  (``started_at`` promotes on pop), not assignment time, so pipelined
+  EWMA measures true device rate.
 - **Result validation.** Every Result is re-checked with one hashlib call
   (``hash_nonce(data, nonce) == hash`` and nonce within the assigned
   interval) before folding — a lying or bit-flipping miner tier cannot
@@ -56,14 +68,38 @@ JobKey = Tuple[str, int, int]  # (data, lower, upper) — checkpoint identity
 
 
 @dataclass
+class _Asgn:
+    """One outstanding chunk assignment in a miner's FIFO queue."""
+
+    job: int  # client conn_id
+    interval: Interval
+    assigned_at: float
+    started_at: float  # when it reached the queue front (rate/straggler base)
+    timed_out: bool = False  # reclaimed by the straggler tick
+
+
+@dataclass
 class _Miner:
     conn_id: int
-    job: Optional[int] = None  # client conn_id currently served
-    interval: Optional[Interval] = None
-    assigned_at: float = 0.0
+    queue: Deque[_Asgn] = field(default_factory=deque)  # FIFO, front = active
     rate: float = 0.0  # EWMA nonces/sec; 0 = unknown
-    timed_out: bool = False  # chunk reclaimed by the straggler tick
     rejects: int = 0  # invalid Results so far (strikes)
+    last_size: int = 0  # last completed chunk (geometric ramp boost)
+    last_elapsed: float = 0.0
+
+    # Front-of-queue views: the chunk the miner is computing NOW (the rest
+    # of the queue is transport-buffered, not started).
+    @property
+    def job(self) -> Optional[int]:
+        return self.queue[0].job if self.queue else None
+
+    @property
+    def interval(self) -> Optional[Interval]:
+        return self.queue[0].interval if self.queue else None
+
+    @property
+    def timed_out(self) -> bool:
+        return self.queue[0].timed_out if self.queue else False
 
 
 @dataclass
@@ -73,10 +109,11 @@ class _Job:
     lower: int
     upper: int
     pending: Deque[Interval] = field(default_factory=deque)
-    outstanding: Dict[int, Interval] = field(default_factory=dict)
+    # conn_id -> intervals that miner holds (pipeline: possibly several).
+    outstanding: Dict[int, List[Interval]] = field(default_factory=dict)
     # Straggler-reclaimed intervals, by the slow miner's conn_id: if its
     # Result does arrive first, the duplicate pending copy is withdrawn.
-    requeued: Dict[int, Interval] = field(default_factory=dict)
+    requeued: Dict[int, List[Interval]] = field(default_factory=dict)
     best: Optional[Tuple[int, int]] = None  # (hash, nonce)
 
     def fold(self, hash_: int, nonce: int) -> None:
@@ -91,6 +128,14 @@ class _Job:
     @property
     def key(self) -> JobKey:
         return (self.data, self.lower, self.upper)
+
+    def remove_outstanding(self, conn_id: int, interval: Interval) -> None:
+        lst = self.outstanding.get(conn_id)
+        if lst is not None:
+            if interval in lst:
+                lst.remove(interval)
+            if not lst:
+                del self.outstanding[conn_id]
 
 
 class Scheduler:
@@ -107,8 +152,12 @@ class Scheduler:
         max_rejects: int = 3,
         straggler_factor: float = 4.0,
         straggler_min_seconds: float = 10.0,
+        pipeline_depth: int = 2,
+        ramp_factor: int = 8,
         resume_state: Optional[dict] = None,
     ) -> None:
+        if pipeline_depth < 1:
+            raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
         self.min_chunk = min_chunk
         self.max_chunk = max_chunk
         self.target_chunk_seconds = target_chunk_seconds
@@ -117,6 +166,8 @@ class Scheduler:
         self.max_rejects = max_rejects
         self.straggler_factor = straggler_factor
         self.straggler_min_seconds = straggler_min_seconds
+        self.pipeline_depth = pipeline_depth
+        self.ramp_factor = ramp_factor
         self.miners: Dict[int, _Miner] = {}
         self.jobs: Dict[int, _Job] = {}
         self._job_rr: Deque[int] = deque()  # round-robin order of job ids
@@ -173,40 +224,52 @@ class Scheduler:
     ) -> List[Action]:
         self.revision += 1
         miner = self.miners.get(conn_id)
-        if miner is None or miner.interval is None:
+        if miner is None or not miner.queue:
             return []  # Result from a non-miner or an unassigned miner
-        lo, hi = miner.interval
-        job = self.jobs.get(miner.job)  # None if the client died meanwhile
+        # FIFO matching: LSP delivers Requests in order and the miner
+        # answers in order, so a Result always closes the queue front.
+        front = miner.queue[0]
+        lo, hi = front.interval
+        job = self.jobs.get(front.job)  # None if the client died meanwhile
 
         if job is not None and self.validate_results:
             valid = lo <= nonce <= hi and hash_nonce(job.data, nonce) == hash_
             if not valid:
                 return self._reject_result(miner, job, now)
 
-        elapsed = max(now - miner.assigned_at, 1e-6)
-        sample = (hi - lo + 1) / elapsed
+        miner.queue.popleft()
+        # Rate sample over the result-to-result gap: started_at is promoted
+        # when an assignment reaches the front, so a pipelined miner's EWMA
+        # measures device rate, not queue wait.
+        elapsed = max(now - front.started_at, 1e-6)
+        size = hi - lo + 1
+        sample = size / elapsed
         miner.rate = (
             sample
             if miner.rate == 0.0
             else self.rate_alpha * sample + (1 - self.rate_alpha) * miner.rate
         )
-        was_timed_out = miner.timed_out
-        miner.job = None
-        miner.interval = None
-        miner.timed_out = False
+        miner.last_size = size
+        miner.last_elapsed = elapsed
+        if miner.queue:
+            nxt = miner.queue[0]
+            nxt.started_at = max(nxt.started_at, now)
         actions: List[Action] = []
         if job is not None:
-            job.outstanding.pop(conn_id, None)
-            if was_timed_out:
+            job.remove_outstanding(conn_id, front.interval)
+            if front.timed_out:
                 # The slow miner finished after all: withdraw whatever of
                 # its re-queued duplicate is still pending.  Dispatch may
                 # have split the duplicate into differently-shaped chunks,
                 # so subtract the interval rather than matching it whole
                 # (parts already handed to other miners are re-swept; the
                 # min-fold makes that harmless).
-                dup = job.requeued.pop(conn_id, None)
-                if dup is not None:
-                    _subtract_pending(job, dup)
+                dups = job.requeued.get(conn_id)
+                if dups and front.interval in dups:
+                    dups.remove(front.interval)
+                    if not dups:
+                        del job.requeued[conn_id]
+                    _subtract_pending(job, front.interval)
             job.fold(hash_, nonce)
             if job.done:
                 actions.append(self._finish_job(job))
@@ -218,16 +281,20 @@ class Scheduler:
         self.revision += 1
         miner = self.miners.pop(conn_id, None)
         if miner is not None:
-            job = self.jobs.get(miner.job) if miner.job is not None else None
-            if job is not None and miner.interval is not None:
-                job.outstanding.pop(conn_id, None)
-                job.requeued.pop(conn_id, None)
-                if not miner.timed_out:
-                    # Reassign: return the chunk to the *front* so low nonces
-                    # stay first (keeps the lowest-nonce tie-break cheap).
-                    # (A timed-out miner's chunk was already re-queued.)
-                    job.pending.appendleft(miner.interval)
+            # Reassign every queued chunk, front first: appendleft in
+            # reverse queue order keeps low nonces first (cheap
+            # lowest-nonce tie-break).  Timed-out chunks were already
+            # re-queued by the straggler tick.
+            for asgn in reversed(miner.queue):
+                job = self.jobs.get(asgn.job)
+                if job is None:
+                    continue
+                job.remove_outstanding(conn_id, asgn.interval)
+                if not asgn.timed_out:
+                    job.pending.appendleft(asgn.interval)
                     METRICS.inc("sched.chunks_reassigned")
+            for job in self.jobs.values():
+                job.requeued.pop(conn_id, None)
             return self._dispatch(now)
         job = self.jobs.pop(conn_id, None)
         if job is not None:
@@ -244,26 +311,35 @@ class Scheduler:
         """
         reclaimed = False
         for miner in self.miners.values():
-            if miner.interval is None or miner.timed_out:
+            # Only the first non-timed-out assignment is "running"; later
+            # queue entries haven't started (FIFO miner).  Timed-out flags
+            # therefore always form a queue prefix.
+            asgn = next((a for a in miner.queue if not a.timed_out), None)
+            if asgn is None:
                 continue
-            lo, hi = miner.interval
+            lo, hi = asgn.interval
             expected = (
                 (hi - lo + 1) / miner.rate
                 if miner.rate > 0.0
                 else self.target_chunk_seconds
             )
-            deadline = miner.assigned_at + max(
+            deadline = asgn.started_at + max(
                 self.straggler_factor * expected, self.straggler_min_seconds
             )
             if now < deadline:
                 continue
-            job = self.jobs.get(miner.job)
+            job = self.jobs.get(asgn.job)
             if job is None:
                 continue
-            miner.timed_out = True
-            job.outstanding.pop(miner.conn_id, None)
-            job.pending.appendleft(miner.interval)
-            job.requeued[miner.conn_id] = miner.interval
+            asgn.timed_out = True
+            job.remove_outstanding(miner.conn_id, asgn.interval)
+            job.pending.appendleft(asgn.interval)
+            job.requeued.setdefault(miner.conn_id, []).append(asgn.interval)
+            # The successor's straggler clock starts now — it could not
+            # have been computing while its predecessor wedged the miner.
+            nxt = next((a for a in miner.queue if not a.timed_out), None)
+            if nxt is not None:
+                nxt.started_at = max(nxt.started_at, now)
             METRICS.inc("sched.chunks_straggler_requeued")
             self.revision += 1
             reclaimed = True
@@ -278,7 +354,9 @@ class Scheduler:
         """
         merged: Dict[JobKey, Tuple[Optional[Tuple[int, int]], List[Interval]]] = {}
         for job in self.jobs.values():
-            remaining = list(job.pending) + list(job.outstanding.values())
+            remaining = list(job.pending) + [
+                iv for lst in job.outstanding.values() for iv in lst
+            ]
             _merge_progress(merged, job.key, job.best, remaining)
         # Orphaned progress (job's client died / fleet restarted) persists
         # too.  Same-key entries (live job + orphan, or two identical
@@ -318,20 +396,37 @@ class Scheduler:
         """Invalid Result: drop it, re-queue the chunk, strike the miner."""
         METRICS.inc("sched.results_rejected")
         miner.rejects += 1
-        interval = miner.interval
-        was_timed_out = miner.timed_out
-        miner.job = None
-        miner.interval = None
-        miner.timed_out = False
-        job.outstanding.pop(miner.conn_id, None)
-        if was_timed_out:
+        front = miner.queue.popleft()
+        job.remove_outstanding(miner.conn_id, front.interval)
+        if front.timed_out:
             # Chunk already re-queued by the straggler tick; keep that copy.
-            job.requeued.pop(miner.conn_id, None)
-        else:
-            job.pending.appendleft(interval)
-        if miner.rejects >= self.max_rejects:
+            dups = job.requeued.get(miner.conn_id)
+            if dups and front.interval in dups:
+                dups.remove(front.interval)
+                if not dups:
+                    del job.requeued[miner.conn_id]
+        if miner.queue:
+            miner.queue[0].started_at = max(miner.queue[0].started_at, now)
+        evicted = miner.rejects >= self.max_rejects
+        if evicted:
             METRICS.inc("sched.miners_evicted")
             del self.miners[miner.conn_id]
+        # Re-queue front first, then (on eviction) its queued successors —
+        # one reversed pass over [front, *queue] keeps low nonces first
+        # (same order rule as lost()).
+        takeback = [front] + (list(miner.queue) if evicted else [])
+        for asgn in reversed(takeback):
+            j = self.jobs.get(asgn.job)
+            if j is None or asgn.timed_out:
+                continue
+            if asgn is not front:
+                j.remove_outstanding(miner.conn_id, asgn.interval)
+            j.pending.appendleft(asgn.interval)
+        if evicted:
+            # No Result can ever arrive from the banned conn: drop its
+            # stale straggler-withdrawal records (same hygiene as lost()).
+            for j in self.jobs.values():
+                j.requeued.pop(miner.conn_id, None)
             # Ban the conn (a re-Join would reset the strike count) and ask
             # the shell to close it via drain_evictions().
             self._banned.add(miner.conn_id)
@@ -347,8 +442,18 @@ class Scheduler:
 
     def _chunk_size(self, miner: _Miner) -> int:
         if miner.rate <= 0.0:
-            return self.min_chunk
-        size = int(miner.rate * self.target_chunk_seconds)
+            size = self.min_chunk
+        else:
+            size = int(miner.rate * self.target_chunk_seconds)
+        # Geometric ramp boost: while chunks complete in well under the
+        # target, the EWMA (which includes per-chunk latency) understates
+        # the miner — probe ramp_factor× the last chunk so a TPU reaches
+        # full-size chunks in ~6 round-trips instead of ~15.
+        if (
+            miner.last_size
+            and miner.last_elapsed < self.target_chunk_seconds / 2
+        ):
+            size = max(size, miner.last_size * self.ramp_factor)
         return max(self.min_chunk, min(size, self.max_chunk))
 
     def _next_job(self) -> Optional[_Job]:
@@ -363,26 +468,48 @@ class Scheduler:
 
     def _dispatch(self, now: float) -> List[Action]:
         actions: List[Action] = []
-        idle = [m for m in self.miners.values() if m.job is None]
-        # Fastest miners first: they drain the most work per assignment.
-        # Miners with validation strikes sort last — a re-queued chunk should
-        # land on a trustworthy peer, not bounce back to the liar.
-        idle.sort(key=lambda m: (m.rejects, -m.rate))
-        for miner in idle:
-            job = self._next_job()
-            if job is None:
-                break
-            lo, hi = job.pending.popleft()
-            size = self._chunk_size(miner)
-            cut = min(hi, lo + size - 1)
-            if cut < hi:
-                job.pending.appendleft((cut + 1, hi))
-            miner.job = job.client_id
-            miner.interval = (lo, cut)
-            miner.assigned_at = now
-            job.outstanding[miner.conn_id] = (lo, cut)
-            METRICS.inc("sched.chunks_assigned")
-            actions.append((miner.conn_id, Message.request(job.data, lo, cut)))
+        # Breadth-first over pipeline levels: every miner gets its first
+        # chunk before anyone gets a second, so pipelining never starves a
+        # peer.  Within a level, fastest miners first: they drain the most
+        # work per assignment.  Miners with validation strikes sort last —
+        # a re-queued chunk should land on a trustworthy peer, not bounce
+        # back to the liar.
+        for level in range(self.pipeline_depth):
+            # A miner holding a timed-out (straggler-reclaimed) chunk is
+            # presumed hung: no new work until it answers or dies —
+            # otherwise its own re-queued duplicate bounces back to it.
+            ready = [
+                m
+                for m in self.miners.values()
+                if len(m.queue) == level
+                and not any(a.timed_out for a in m.queue)
+            ]
+            ready.sort(key=lambda m: (m.rejects, -m.rate))
+            for miner in ready:
+                job = self._next_job()
+                if job is None:
+                    return actions
+                lo, hi = job.pending.popleft()
+                size = self._chunk_size(miner)
+                cut = min(hi, lo + size - 1)
+                if cut < hi:
+                    job.pending.appendleft((cut + 1, hi))
+                # A queued (not-yet-front) assignment starts its clock when
+                # it reaches the front (see result()); until then its
+                # started_at only matters if the queue is empty now.
+                miner.queue.append(
+                    _Asgn(
+                        job=job.client_id,
+                        interval=(lo, cut),
+                        assigned_at=now,
+                        started_at=now,
+                    )
+                )
+                job.outstanding.setdefault(miner.conn_id, []).append((lo, cut))
+                METRICS.inc("sched.chunks_assigned")
+                actions.append(
+                    (miner.conn_id, Message.request(job.data, lo, cut))
+                )
         return actions
 
     def drain_evictions(self) -> List[int]:
@@ -396,11 +523,13 @@ class Scheduler:
     def stats(self) -> Dict[str, int]:
         return {
             "miners": len(self.miners),
-            "idle_miners": sum(1 for m in self.miners.values() if m.job is None),
+            "idle_miners": sum(1 for m in self.miners.values() if not m.queue),
             "jobs": len(self.jobs),
             "pending_intervals": sum(len(j.pending) for j in self.jobs.values()),
             "outstanding_chunks": sum(
-                len(j.outstanding) for j in self.jobs.values()
+                len(lst)
+                for j in self.jobs.values()
+                for lst in j.outstanding.values()
             ),
         }
 

@@ -52,6 +52,7 @@ def serve(
     clock=time.monotonic,
     tick_interval: float = 1.0,
     checkpoint_path: Optional[str] = None,
+    health_interval: float = 10.0,
 ) -> None:
     """Run the scheduler loop over an already-listening LSP server until the
     server is closed.  Factored out of main() so tests drive it in-process.
@@ -64,6 +65,28 @@ def serve(
     sched = scheduler if scheduler is not None else Scheduler()
     log = log or logging.getLogger("bitcoin_miner_tpu.server")
     lock = threading.Lock()  # serializes scheduler access with the ticker
+    # Operator health surface (the reference's LOGF scaffold,
+    # bitcoin/server/server.go:26-39, implies exactly this): periodic
+    # scheduler stats + recovery counters in log.txt, so reassignment/
+    # validation/straggler machinery is visible without a debugger.
+    health_every = max(1, int(round(health_interval / tick_interval)))
+
+    def health_line() -> str:
+        from ..utils.metrics import METRICS
+
+        counters = {
+            k: METRICS.get(f"sched.{k}")
+            for k in (
+                "chunks_assigned",
+                "chunks_reassigned",
+                "chunks_straggler_requeued",
+                "results_rejected",
+                "miners_evicted",
+                "jobs_completed",
+                "jobs_resumed",
+            )
+        }
+        return f"health {sched.stats()} {counters}"
 
     def emit(actions) -> None:
         for conn_id, msg in actions:
@@ -78,8 +101,11 @@ def serve(
 
     def ticker() -> None:
         saved_rev = None
+        ticks = 0
+        last_health = None
         while not stop.wait(tick_interval):
             try:
+                ticks += 1
                 with lock:
                     actions = sched.tick(clock())
                     rev = sched.revision
@@ -88,6 +114,12 @@ def serve(
                         if checkpoint_path and rev != saved_rev
                         else None
                     )
+                    line = (
+                        health_line() if ticks % health_every == 0 else None
+                    )
+                if line is not None and line != last_health:
+                    log.info("%s", line)  # skip repeats on an idle server
+                    last_health = line
                 if actions:
                     log.info("straggler tick reclaimed work")
                     emit(actions)

@@ -283,6 +283,200 @@ def run_sweep_dispatches(
     return lanes
 
 
+def _build_kernel(backend, batch, tile, cpb, interpret, rolled, layout, group):
+    """One place for the backend-specific kernel construction (shared by
+    the synchronous driver and SweepPipeline; both are lru_cached below)."""
+    low_pos = layout.digit_pos[layout.digit_count - group.k :]
+    if backend == "pallas":
+        from .pallas_sha256 import DEFAULT_TILE, make_pallas_minhash
+
+        return make_pallas_minhash(
+            layout.n_tail_blocks,
+            low_pos,
+            group.k,
+            batch,
+            tile=tile if tile is not None else DEFAULT_TILE,
+            interpret=interpret,
+            cpb=cpb,
+        )
+    return _make_kernel(layout.n_tail_blocks, low_pos, group.k, batch, rolled)
+
+
+def _invoke_kernel(backend, kern, midstate, tail_const, bounds):
+    """One place for the backend-specific calling convention (the pallas
+    tier takes the chunk table + bounds as one flattened operand)."""
+    if backend == "pallas":
+        tailcb = np.concatenate([tail_const, bounds.astype(np.uint32)], axis=1)
+        return kern(jnp.asarray(midstate), jnp.asarray(tailcb))
+    return kern(
+        jnp.asarray(midstate), jnp.asarray(tail_const), jnp.asarray(bounds)
+    )
+
+
+class SweepPipeline:
+    """Cross-request sweep pipeline: the device never idles between jobs.
+
+    A synchronous :func:`sweep_min_hash` call pays the dispatch+fetch
+    latency of the tunnelled runtime (~0.2 s measured on the v5e tunnel)
+    once per call, and concurrent calls from separate threads race their
+    dispatch enqueues so the device interleaves both jobs and both finish
+    late (measured r5: a pipelined fleet stuck at ~38% of kernel rate).
+    This pipeline serializes *enqueue* order in one dispatcher thread —
+    jobs' dispatches land on the device queue back-to-back, FIFO — while a
+    fetcher thread blocks on results in the same order and resolves each
+    job's future the moment its last dispatch lands.  Submitting job N+1
+    while job N computes therefore costs zero device idle, and results
+    stream back with per-job latency, not per-job-pair bursts.
+
+    Used by the miner worker (apps/miner.py) to serve the scheduler's
+    pipelined 2-deep assignment window; ``submit`` is thread-safe.
+    """
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        *,
+        max_k: Optional[int] = None,
+        batch: Optional[int] = None,
+        tile: Optional[int] = None,
+        cpb: Optional[int] = None,
+        backend: Optional[str] = None,
+        interpret: bool = False,
+        max_inflight: int = 32,
+    ) -> None:
+        import queue as _queue
+        import threading
+        from concurrent.futures import Future
+
+        self._Future = Future
+        self._backend, self._batch, self._max_k = auto_tune(backend, batch, max_k)
+        self._tile = tile
+        self._cpb = cpb
+        self._interpret = interpret
+        self._rolled = not is_tpu()
+        self._jobs: "_queue.Queue" = _queue.Queue()
+        # Backpressure: bounds both host memory and the device backlog.
+        self._fetches: "_queue.Queue" = _queue.Queue(maxsize=max_inflight)
+        self._closed = False
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="sweep-dispatch", daemon=True
+        )
+        self._fetcher = threading.Thread(
+            target=self._fetch_loop, name="sweep-fetch", daemon=True
+        )
+        self._dispatcher.start()
+        self._fetcher.start()
+
+    def submit(self, data: str, lower: int, upper: int):
+        """Queue one sweep; returns a Future of :class:`SweepResult`."""
+        if self._closed:
+            raise RuntimeError("pipeline closed")
+        fut = self._Future()
+        self._jobs.put((data, lower, upper, fut))
+        return fut
+
+    def close(self) -> None:
+        self._closed = True
+        self._jobs.put(None)
+
+    # ------------------------------------------------------------- threads
+
+    @staticmethod
+    def _fail(fut, e: BaseException) -> None:
+        """Resolve a Future to an error, tolerating the dispatcher/fetcher
+        race where both observe the same device failure — the loser's
+        InvalidStateError must not kill its pipeline thread."""
+        try:
+            fut.set_exception(e)
+        except Exception:
+            pass  # already resolved by the other thread
+
+    def _get_kernel(self, layout, group):
+        return _build_kernel(
+            self._backend,
+            self._batch,
+            self._tile,
+            self._cpb,
+            self._interpret,
+            self._rolled,
+            layout,
+            group,
+        )
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            item = self._jobs.get()
+            if item is None:
+                self._fetches.put(None)
+                return
+            data, lower, upper, fut = item
+            state = {"best": [], "lanes": 0, "fut": fut}
+
+            def run_kernel(kern, midstate, tail_const, bounds):
+                return _invoke_kernel(
+                    self._backend, kern, midstate, tail_const, bounds
+                )
+
+            def consume(out, bases, n_lanes) -> None:
+                # Blocks when max_inflight results are unfetched — that's
+                # the backpressure; the device queue stays deep meanwhile.
+                self._fetches.put((state, out, bases, n_lanes))
+
+            try:
+                state["lanes"] = run_sweep_dispatches(
+                    data,
+                    lower,
+                    upper,
+                    self._max_k,
+                    self._batch,
+                    self._get_kernel,
+                    run_kernel,
+                    consume,
+                )
+            except BaseException as e:  # resolve, don't kill the pipeline
+                self._fail(fut, e)
+                continue
+            self._fetches.put((state, self._DONE, None, None))
+
+    def _fetch_loop(self) -> None:
+        while True:
+            item = self._fetches.get()
+            if item is None:
+                return
+            state, out, bases, n_lanes = item
+            fut = state["fut"]
+            if out is self._DONE:
+                if not fut.done():  # not already failed by the dispatcher
+                    best = state["best"]
+                    if not best:
+                        self._fail(
+                            fut, RuntimeError("sweep produced no candidates")
+                        )
+                    else:
+                        fut.set_result(
+                            SweepResult(
+                                hash=best[0][0],
+                                nonce=best[0][1],
+                                lanes_swept=state["lanes"],
+                            )
+                        )
+                continue
+            if fut.done():
+                continue  # job already failed; drain its remaining fetches
+            try:
+                h0, h1, flat_idx = out
+                fi = int(flat_idx)  # blocks until the dispatch lands
+                if fi != I32_MAX:
+                    h = (int(h0) << 32) | int(h1)
+                    cand = (h, bases[fi // n_lanes] + fi % n_lanes)
+                    best = state["best"]
+                    if not best or cand < best[0]:
+                        best[:] = [cand]
+            except BaseException as e:
+                self._fail(fut, e)
+
+
 def sweep_min_hash(
     data: str,
     lower: int,
@@ -315,28 +509,12 @@ def sweep_min_hash(
     rolled = not is_tpu()
 
     def get_kernel(layout, group):
-        low_pos = layout.digit_pos[layout.digit_count - group.k :]
-        if backend == "pallas":
-            from .pallas_sha256 import DEFAULT_TILE, make_pallas_minhash
-
-            return make_pallas_minhash(
-                layout.n_tail_blocks,
-                low_pos,
-                group.k,
-                batch,
-                tile=tile if tile is not None else DEFAULT_TILE,
-                interpret=interpret,
-                cpb=cpb,  # None = largest divisor of batch up to the default
-            )
-        return _make_kernel(layout.n_tail_blocks, low_pos, group.k, batch, rolled)
+        return _build_kernel(
+            backend, batch, tile, cpb, interpret, rolled, layout, group
+        )
 
     def run_kernel(kern, midstate, tail_const, bounds):
-        if backend == "pallas":
-            tailcb = np.concatenate([tail_const, bounds.astype(np.uint32)], axis=1)
-            return kern(jnp.asarray(midstate), jnp.asarray(tailcb))
-        return kern(
-            jnp.asarray(midstate), jnp.asarray(tail_const), jnp.asarray(bounds)
-        )
+        return _invoke_kernel(backend, kern, midstate, tail_const, bounds)
 
     best: List[Tuple[int, int]] = []  # [(hash, nonce)] — current minimum
 

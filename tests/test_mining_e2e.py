@@ -417,3 +417,70 @@ def test_adversarial_fleet_soak():
         hold.set()
         lspnet.reset_faults()
         server.close()
+
+
+def test_u64_edge_fleet_e2e():
+    """A job at the top of the uint64 nonce space — [2^64 − 3·10^6, 2^64 − 1],
+    20-digit decimal templates — through the FULL fleet (scheduler → LSP →
+    heterogeneous native + xla miners → min-fold), checked bit-exact against
+    the hashlib oracle.  Pins the `Lower, Upper uint64` wire contract
+    (reference bitcoin/message.go:21) end-to-end, not just at the ops tier."""
+    U64 = (1 << 64) - 1
+    lo = U64 - 3_000_000 + 1
+    sys_ = MiningSystem(n_miners=1)  # one native/cpu-tier miner...
+    try:
+        sys_.add_miner(miner_mod.make_search("xla"))  # ...plus one xla-tier
+        c = lsp.Client("127.0.0.1", sys_.port, PARAMS)
+        try:
+            c.write(Message.request("cmu440", lo, U64).marshal())
+            msg = Message.unmarshal(c.read())
+        finally:
+            c.close()
+        assert (msg.hash, msg.nonce) == min_hash_range("cmu440", lo, U64)
+    finally:
+        sys_.close()
+
+
+def test_server_logs_health(caplog):
+    """The server shell periodically logs scheduler stats + recovery
+    counters (the observability surface the reference's LOGF scaffold
+    implies, bitcoin/server/server.go:26-39)."""
+    import logging
+
+    logger = logging.getLogger("test.health")
+    server = lsp.Server(0, PARAMS)
+    sched = Scheduler(min_chunk=500)
+    threading.Thread(
+        target=server_mod.serve,
+        args=(server, sched),
+        kwargs={
+            "log": logger,
+            "tick_interval": 0.05,
+            "health_interval": 0.2,
+        },
+        daemon=True,
+    ).start()
+    try:
+        with caplog.at_level(logging.INFO, logger="test.health"):
+            c = lsp.Client("127.0.0.1", server.port, PARAMS)
+            mc = lsp.Client("127.0.0.1", server.port, PARAMS)
+            threading.Thread(
+                target=miner_mod.run_miner,
+                args=(mc, miner_mod.make_search("cpu")),
+                daemon=True,
+            ).start()
+            try:
+                assert client_mod.request_once(c, "health", 2000) == (
+                    min_hash_range("health", 0, 2000)
+                )
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline and "health {" not in caplog.text:
+                    time.sleep(0.1)
+            finally:
+                c.close()
+        assert "health {" in caplog.text
+        assert "'miners': 1" in caplog.text
+        assert "chunks_assigned" in caplog.text
+        assert "jobs_completed" in caplog.text
+    finally:
+        server.close()

@@ -2,20 +2,26 @@
 
 tests/test_multihost.py covers the broadcast protocol single-process; this
 spawns TWO real processes that join one ``jax.distributed`` job over a
-loopback coordinator (CPU backend, one device per process), build the
-global mesh, broadcast a Request host-0-to-all, and run the sharded sweep
-over the cross-process mesh — the exact wiring
+loopback coordinator (CPU backend, TWO devices per process — the mixed
+intra-process "ICI" + inter-process "DCN" shape of a real pod), build the
+2x2 global mesh, broadcast a Request host-0-to-all, and run the sharded
+sweep over the cross-process mesh — the exact wiring
 ``apps/miner.py --multihost`` uses on a TPU pod (run_miner_multihost),
-which previously never executed anywhere (VERDICT r3 item 25).
+which previously never executed anywhere (VERDICT r3 item 25).  A second
+test drills host death: the primary of a live multihost miner is killed
+mid-job and the scheduler reassigns its range to a replacement miner
+(SURVEY §5 failure recovery; BASELINE config 5).
 """
 
 import json
+import os
+import signal
 import socket
 import subprocess
 import sys
+import threading
+import time
 from pathlib import Path
-
-import pytest
 
 REPO = Path(__file__).resolve().parents[1]
 
@@ -31,8 +37,9 @@ host_id, port = int(sys.argv[1]), sys.argv[2]
 multihost.initialize(f"127.0.0.1:{port}", 2, host_id)
 assert jax.process_count() == 2, jax.process_count()
 assert multihost.is_primary() == (host_id == 0)
+assert jax.local_device_count() == 2, jax.local_devices()
 mesh = multihost.global_mesh()
-assert mesh.devices.size == 2, mesh  # one CPU device per process
+assert mesh.devices.size == 4, mesh  # 2 hosts x 2 devices: ICI+DCN shape
 
 # Host 0 owns the Request; everyone gets it via the collective broadcast
 # (serve_multihost's loop body, apps/miner.py).
@@ -56,20 +63,18 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.timeout(180)
-def test_two_process_distributed_sweep(tmp_path):
+def test_two_process_2x2_distributed_sweep(tmp_path):
     port = _free_port()
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
-    import os
 
     env = {
         **os.environ,
         "PYTHONPATH": str(REPO),
-        # One plain CPU device per process: drop the 8-virtual-device
+        # Two plain CPU devices per process: replaces the 8-virtual-device
         # XLA_FLAGS the test session itself runs under (conftest.py).
         "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
     }
     procs = [
         subprocess.Popen(
@@ -84,6 +89,8 @@ def test_two_process_distributed_sweep(tmp_path):
     ]
     outs = []
     for p in procs:
+        # communicate(timeout=) bounds the realistic hang path (a worker
+        # that never finishes); pytest-timeout isn't installed, so no mark.
         out, err = p.communicate(timeout=150)
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(out)
@@ -96,3 +103,96 @@ def test_two_process_distributed_sweep(tmp_path):
     # Secondary host emits no Result (only host 0 owns the LSP side);
     # runtime chatter like Gloo's connection line is fine.
     assert not [l for l in outs[1].splitlines() if l.startswith("{")]
+
+
+def test_host0_death_mid_job_scheduler_reassigns(tmp_path):
+    """Kill the multihost miner's primary (the host holding the LSP conn)
+    mid-job: the scheduler must detect the dead conn, reassign its
+    outstanding range to a replacement miner, and the client must still
+    get the bit-exact min."""
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.apps import client as client_mod
+    from bitcoin_miner_tpu.apps import miner as miner_mod
+    from bitcoin_miner_tpu.apps import server as server_mod
+    from bitcoin_miner_tpu.apps.scheduler import Scheduler
+    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+    from bitcoin_miner_tpu.utils.metrics import METRICS
+
+    METRICS.reset()
+    params = lsp.Params(epoch_limit=5, epoch_millis=200, window_size=5)
+    server = lsp.Server(0, params)
+    sched = Scheduler(min_chunk=20_000)
+    threading.Thread(
+        target=server_mod.serve,
+        args=(server, sched),
+        kwargs={"tick_interval": 0.2},
+        daemon=True,
+    ).start()
+
+    coord = _free_port()
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+    }
+    hosts = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "bitcoin_miner_tpu.apps.miner",
+                f"127.0.0.1:{server.port}",
+                "--multihost",
+                f"--coordinator=127.0.0.1:{coord}",
+                "--num-hosts=2",
+                f"--host-id={i}",
+            ],
+            env=env,
+            cwd=REPO,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        for i in range(2)
+    ]
+    data, mx = "hostdeath", 2_000_000
+    result_box = {}
+
+    def run_client():
+        c = lsp.Client("127.0.0.1", server.port, params)
+        try:
+            result_box["r"] = client_mod.request_once(c, data, mx)
+        finally:
+            c.close()
+
+    ct = threading.Thread(target=run_client, daemon=True)
+    backup_client = None
+    try:
+        ct.start()
+        # Wait until the multihost miner holds assigned chunks (mid-job)...
+        deadline = time.monotonic() + 120
+        while (
+            METRICS.get("sched.chunks_assigned") < 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        assert METRICS.get("sched.chunks_assigned") >= 2, "miner never ramped"
+        # ...then kill the primary outright (no goodbye over LSP).
+        hosts[0].send_signal(signal.SIGKILL)
+        # Replacement worker: the epoch heartbeat declares the dead conn,
+        # lost() re-queues its chunks, dispatch hands them here.
+        backup_client = lsp.Client("127.0.0.1", server.port, params)
+        threading.Thread(
+            target=miner_mod.run_miner,
+            args=(backup_client, miner_mod.make_search("cpu")),
+            daemon=True,
+        ).start()
+        ct.join(timeout=120)
+        assert not ct.is_alive(), "client starved after host-0 death"
+        assert result_box["r"] == min_hash_range(data, 0, mx)
+        assert METRICS.get("sched.chunks_reassigned") >= 1
+    finally:
+        for p in hosts:
+            if p.poll() is None:
+                p.kill()
+        server.close()

@@ -113,6 +113,109 @@ class TestFaults:
         assert (actions[0][1].lower, actions[0][1].upper) == (0, 99)
 
 
+class TestPipelining:
+    def test_results_match_fifo(self):
+        # Two chunks queued at one miner; results close them oldest-first.
+        s = Scheduler(validate_results=False, min_chunk=100, max_chunk=100)
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "d", 0, 299, now=0.0)
+        assert [a.interval for a in s.miners[1].queue] == [(0, 99), (100, 199)]
+        s.result(1, hash_=5, nonce=7, now=1.0)
+        # (0,99) closed; (100,199) promoted to front; refill appended.
+        assert s.miners[1].queue[0].interval == (100, 199)
+        assert 0 not in [iv for lst in s.jobs[10].outstanding.values() for iv in lst]
+
+    def test_rate_uses_result_gap_not_assignment_time(self):
+        # Both chunks assigned at t=0; results at t=10 and t=11.  The second
+        # sample must be size/1s (result gap), not size/11s.
+        s = Scheduler(
+            validate_results=False, min_chunk=100, max_chunk=100, rate_alpha=1.0
+        )
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "d", 0, 299, now=0.0)
+        s.result(1, hash_=5, nonce=7, now=10.0)
+        assert s.miners[1].rate == 100 / 10.0
+        s.result(1, hash_=5, nonce=107, now=11.0)
+        assert s.miners[1].rate == 100 / 1.0
+
+    def test_lost_miner_requeues_all_chunks_in_order(self):
+        s = Scheduler(validate_results=False, min_chunk=100, max_chunk=100)
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "d", 0, 299, now=0.0)  # holds (0,99),(100,199)
+        s.lost(1, now=1.0)
+        assert list(s.jobs[10].pending) == [(0, 99), (100, 199), (200, 299)]
+
+    def test_evicted_liar_requeues_queued_chunks(self):
+        from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+
+        s = Scheduler(min_chunk=100, max_chunk=100, max_rejects=1)
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "cmu440", 0, 299, now=0.0)
+        s.result(1, hash_=1, nonce=2, now=1.0)  # lie -> instant eviction
+        assert 1 not in s.miners
+        # Both the lied-about front chunk AND the queued second chunk are
+        # back in pending, in nonce order.
+        assert list(s.jobs[10].pending) == [(0, 99), (100, 199), (200, 299)]
+        s.miner_joined(2, now=2.0)
+        h, n = min_hash_range("cmu440", 0, 299)
+        for lo in (0, 100, 200):
+            hh, nn = min_hash_range("cmu440", lo, lo + 99)
+            final = s.result(2, hh, nn, now=3.0 + lo)
+        assert final[0][1].hash == h and final[0][1].nonce == n
+
+    def test_straggler_cascade_times_out_successor(self):
+        # Front times out at t=11; the queued successor's clock starts
+        # there, so it times out ~10s later, not immediately.
+        s = Scheduler(
+            validate_results=False,
+            min_chunk=100,
+            max_chunk=100,
+            straggler_min_seconds=10.0,
+        )
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "d", 0, 299, now=0.0)
+        s.tick(11.0)
+        assert [a.timed_out for a in s.miners[1].queue] == [True, False]
+        assert s.tick(12.0) == []  # successor's deadline not reached
+        s.tick(22.0)
+        assert [a.timed_out for a in s.miners[1].queue] == [True, True]
+        # Both duplicates pending (plus the never-assigned third chunk).
+        assert sorted(s.jobs[10].pending) == [(0, 99), (100, 199), (200, 299)]
+
+    def test_hung_miner_gets_no_new_work(self):
+        s = Scheduler(
+            validate_results=False,
+            min_chunk=100,
+            max_chunk=100,
+            straggler_min_seconds=10.0,
+        )
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "d", 0, 99, now=0.0)
+        assert s.tick(11.0) == []  # re-queued, but the only miner is hung
+        assert list(s.jobs[10].pending) == [(0, 99)]
+        assert len(s.miners[1].queue) == 1  # NOT handed its own duplicate
+
+    def test_ramp_boost_grows_chunks_geometrically(self):
+        # A fast miner completing min_chunk in a blink gets ramp_factor x
+        # its last chunk, not just rate*target (which the per-chunk latency
+        # in the EWMA understates during ramp).
+        s = Scheduler(
+            validate_results=False,
+            min_chunk=1000,
+            target_chunk_seconds=0.5,
+            rate_alpha=1.0,
+            pipeline_depth=1,
+            ramp_factor=8,
+        )
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "d", 0, 10**9, now=0.0)
+        # 1000 nonces in 0.2s -> EWMA rate 5000/s -> rate-based next chunk
+        # would be 2500; the boost gives 8x1000 = 8000.
+        actions = s.result(1, hash_=5, nonce=7, now=0.2)
+        nxt = actions[0][1]
+        assert nxt.upper - nxt.lower + 1 == 8000
+
+
 class TestAdaptiveChunking:
     def test_fast_miner_gets_bigger_chunks(self):
         s = Scheduler(validate_results=False, min_chunk=100, max_chunk=10**9, target_chunk_seconds=1.0)
@@ -145,7 +248,21 @@ class TestFairness:
         for m in range(1, 5):
             for cid, msg in s.miner_joined(m):
                 served.append(msg.data)
-        assert served.count("a") == 2 and served.count("b") == 2
+        # Each join fills the miner's pipeline (depth 2), round-robin
+        # across jobs: both jobs get an equal share.
+        assert served.count("a") == 4 and served.count("b") == 4
+
+    def test_pipeline_fills_breadth_first(self):
+        # With 2 miners and depth 2, every miner must hold its FIRST chunk
+        # before anyone is handed a second.
+        s = Scheduler(validate_results=False, min_chunk=10, max_chunk=10)
+        s.miner_joined(1)
+        s.miner_joined(2)
+        actions = s.client_request(10, "a", 0, 39)
+        order = [cid for cid, _ in actions]
+        assert sorted(order[:2]) == [1, 2]  # level 0 first
+        assert sorted(order[2:]) == [1, 2]  # then level 1
+        assert all(len(m.queue) == 2 for m in s.miners.values())
 
     def test_duplicate_join_ignored(self):
         s = Scheduler(validate_results=False)
@@ -165,4 +282,5 @@ class TestFairness:
         s.client_request(10, "a", 0, 99)
         st = s.stats()
         assert st["miners"] == 1 and st["idle_miners"] == 0
-        assert st["jobs"] == 1 and st["outstanding_chunks"] == 1
+        # depth-2 pipeline: the lone miner holds two chunks.
+        assert st["jobs"] == 1 and st["outstanding_chunks"] == 2

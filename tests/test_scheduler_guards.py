@@ -117,11 +117,13 @@ class TestStragglerRecovery:
     def test_rate_based_deadline(self):
         # A miner with a known fast rate gets a deadline ~4x its expected
         # chunk duration, not the 10s floor... unless the floor is larger.
+        # depth=1 so exactly one assignment's deadline is under test.
         s = Scheduler(
             min_chunk=100,
             straggler_factor=4.0,
             straggler_min_seconds=0.5,
             target_chunk_seconds=1.0,
+            pipeline_depth=1,
         )
         s.miner_joined(1, now=0.0)
         s.client_request(10, DATA, 0, 10**6, now=0.0)
@@ -148,8 +150,9 @@ class TestStragglerRecovery:
     def test_straggler_withdrawal_survives_chunk_resplitting(self):
         # Dispatch may cut the re-queued duplicate into different chunk
         # shapes; the late Result must still withdraw what remains pending
-        # (interval subtraction, not whole-tuple matching).
-        s = Scheduler(min_chunk=300, straggler_min_seconds=1.0)
+        # (interval subtraction, not whole-tuple matching).  depth=1 keeps
+        # the replacement miner to a single differently-shaped chunk.
+        s = Scheduler(min_chunk=300, straggler_min_seconds=1.0, pipeline_depth=1)
         s.miner_joined(1, now=0.0)
         s.client_request(10, DATA, 0, 299, now=0.0)  # miner 1 holds (0,299)
         s.tick(2.0)  # re-queued; no peer yet
@@ -325,3 +328,29 @@ def test_merge_intervals():
     assert _merge_intervals([(0, 9), (3, 5)]) == [(0, 9)]  # contained
     assert _merge_intervals([(0, 2), (4, 6)]) == [(0, 2), (4, 6)]  # gap
     assert _merge_intervals([(0, 5), (3, 8)]) == [(0, 8)]  # overlap
+
+
+def test_max_chunk_cannot_outgrow_pallas_argmin_guard():
+    """Couples the scheduler's chunk cap to the kernel's int32-argmin guard
+    (ops/pallas_sha256.py: batch * 10^k lanes must fit int32 or the kernel
+    would return silently wrong nonces).  A max_chunk-sized chunk is split
+    into dispatches of (batch, 10^k) by the sweep driver, so the binding
+    invariant is on the pallas tier's DEFAULTS — build the kernels for a
+    full-size chunk's decomposition and let the guard raise if the two
+    limits ever drift apart."""
+    from bitcoin_miner_tpu.ops.pallas_sha256 import make_pallas_minhash
+    from bitcoin_miner_tpu.ops.sweep import (
+        _layout_cache,
+        auto_tune,
+        decompose_range,
+    )
+
+    backend, batch, max_k = auto_tune("pallas", None, None)
+    assert batch * 10**max_k <= 2**31 - 1, "pallas defaults overflow argmin"
+    s = Scheduler()
+    lo = 10**9
+    for group in decompose_range(lo, lo + s.max_chunk - 1, max_k=max_k):
+        layout = _layout_cache(b"cmu440", group.d)
+        low_pos = layout.digit_pos[layout.digit_count - group.k :]
+        # Raises ValueError at construction if batch*10^k overflows int32.
+        make_pallas_minhash(layout.n_tail_blocks, low_pos, group.k, batch)

@@ -29,11 +29,19 @@ def fake_min(lo: int, hi: int):
 
 @pytest.mark.parametrize("seed", [1, 7, 42, 1234, 9999])
 def test_random_interleavings_converge_correctly(seed):
+    from collections import deque
+
     rng = random.Random(seed)
-    sched = Scheduler(validate_results=False, min_chunk=rng.choice([13, 50, 128]), max_chunk=500)
+    depth = rng.choice([1, 2, 3])
+    sched = Scheduler(
+        validate_results=False,
+        min_chunk=rng.choice([13, 50, 128]),
+        max_chunk=500,
+        pipeline_depth=depth,
+    )
 
     next_id = [1]
-    miners = {}   # conn_id -> current (lo, hi) or None
+    miners = {}   # conn_id -> FIFO deque of assigned (lo, hi)
     results = {}  # client_id -> (hash, nonce)
     jobs = {}     # client_id -> max_nonce
     now = [0.0]
@@ -42,8 +50,8 @@ def test_random_interleavings_converge_correctly(seed):
         for cid, msg in actions:
             if msg.type == MsgType.REQUEST:
                 assert cid in miners, "request sent to a non-miner"
-                assert miners[cid] is None, "miner double-assigned"
-                miners[cid] = (msg.lower, msg.upper)
+                assert len(miners[cid]) < depth, "pipeline overfilled"
+                miners[cid].append((msg.lower, msg.upper))
             elif msg.type == MsgType.RESULT:
                 assert cid in jobs, "result sent to unknown client"
                 results[cid] = (msg.hash, msg.nonce)
@@ -62,21 +70,20 @@ def test_random_interleavings_converge_correctly(seed):
     steps = 0
     while len(results) < len(jobs) and steps < 10_000:
         steps += 1
-        busy = [m for m, iv in miners.items() if iv is not None and m in sched.miners]
+        busy = [m for m, q in miners.items() if q and m in sched.miners]
         choice = rng.random()
         if choice < 0.25 or not busy:
             mid = next_id[0]; next_id[0] += 1
-            miners[mid] = None
+            miners[mid] = deque()
             apply(sched.miner_joined(mid, tick()))
         elif choice < 0.40 and busy:
-            mid = rng.choice(busy)  # kill a busy miner mid-chunk
+            mid = rng.choice(busy)  # kill a busy miner mid-chunks
             miners.pop(mid)
             apply(sched.lost(mid, tick()))
         else:
-            mid = rng.choice(busy)  # miner completes its chunk
-            lo, hi = miners[mid]
+            mid = rng.choice(busy)  # miner completes its OLDEST chunk
+            lo, hi = miners[mid].popleft()
             h, n = fake_min(lo, hi)
-            miners[mid] = None
             apply(sched.result(mid, h, n, tick()))
 
     assert len(results) == len(jobs), f"jobs never completed (seed={seed})"
@@ -86,6 +93,8 @@ def test_random_interleavings_converge_correctly(seed):
 
 
 def test_client_death_mid_sim():
+    from collections import deque
+
     rng = random.Random(5)
     sched = Scheduler(validate_results=False, min_chunk=20, max_chunk=100)
     sched.client_request(100, "a", 0, 500)
@@ -96,22 +105,21 @@ def test_client_death_mid_sim():
     def apply(actions):
         for cid, msg in actions:
             if msg.type == MsgType.REQUEST:
-                miners[cid] = (msg.lower, msg.upper)
+                miners[cid].append((msg.lower, msg.upper))
             elif msg.type == MsgType.RESULT:
                 results[cid] = (msg.hash, msg.nonce)
 
     for mid in (1, 2, 3):
-        miners[mid] = None
+        miners[mid] = deque()
         apply(sched.miner_joined(mid))
     apply(sched.lost(100))  # client a dies mid-job
     for _ in range(200):
-        busy = [m for m, iv in miners.items() if iv is not None]
+        busy = [m for m, q in miners.items() if q]
         if not busy:
             break
         mid = rng.choice(busy)
-        lo, hi = miners[mid]
+        lo, hi = miners[mid].popleft()
         h, n = fake_min(lo, hi)
-        miners[mid] = None
         apply(sched.result(mid, h, n))
     assert 100 not in results, "dead client must not receive a Result"
     assert results[101] == fake_min(0, 400)
