@@ -129,6 +129,12 @@ class _PipelineSearch:
         self._p.submit(data, lower, upper).add_done_callback(_done)
         return out
 
+    def prewarm(self, data: str, upper: int) -> None:
+        """Speculatively warm the digit class one past this assignment's
+        upper bound so crossing a digit boundary never stalls the sweep
+        (~14 s/class first-in-process, SweepPipeline.prewarm_async)."""
+        self._p.prewarm_async(data, len(str(upper)) + 1)
+
     def close(self) -> None:
         self._p.close()
 
@@ -190,6 +196,9 @@ def run_miner(client: "lsp.Client", search) -> None:
                 inflight.put(
                     (asearch.submit(msg.data, msg.lower, msg.upper), msg)
                 )
+                prewarm = getattr(asearch, "prewarm", None)
+                if prewarm is not None:
+                    prewarm(msg.data, msg.upper)
             except Exception:
                 # Search closed under us (main loop exiting): a Request
                 # racing the shutdown must not traceback this thread.

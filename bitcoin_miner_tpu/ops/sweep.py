@@ -240,6 +240,48 @@ def auto_tune(
     return backend, batch, max_k
 
 
+@dataclass(frozen=True)
+class HostFold:
+    """A ``(hash, nonce)`` candidate computed on the host for a tiny digit
+    class, passed through a driver's ``consume`` in place of a device
+    output handle.  Routing these off-device means a one-off ``10^d``
+    bucket never pays a 20-40 s Mosaic compile: measured r5, a fleet
+    warm-up job over ``[0, 4e9)`` spent ~150 s compiling d=1..9 kernels
+    whose combined lanes are <1% of one second of device work."""
+
+    hash: int
+    nonce: int
+
+
+def _host_min(data: str, lo: int, hi: int) -> Tuple[int, int]:
+    """Host-tier ``(min hash, argmin nonce)`` over inclusive ``[lo, hi]``:
+    the C++ native tier when built (~1.5e8 n/s multithreaded), else the
+    hashlib oracle (~1e6 n/s)."""
+    try:
+        from .. import native
+
+        if native.available():
+            return native.min_hash_range_native(data, lo, hi)
+    except Exception:
+        pass
+    from ..bitcoin.hash import min_hash_range
+
+    return min_hash_range(data, lo, hi)
+
+
+def auto_host_lane_budget() -> int:
+    """Largest digit-class size worth computing on the host instead of
+    compiling a device kernel for: ~0.1 s of host work either way."""
+    try:
+        from .. import native
+
+        if native.available():
+            return 10**7
+    except Exception:
+        pass
+    return 10**5
+
+
 def run_sweep_dispatches(
     data: str,
     lower: int,
@@ -250,6 +292,7 @@ def run_sweep_dispatches(
     run_kernel,
     consume,
     max_inflight: int = 32,
+    host_lane_budget: int = 0,
 ) -> int:
     """The decompose → template-fill → dispatch skeleton shared by the
     single-device (below) and sharded (parallel/sweep.py) drivers.
@@ -257,7 +300,13 @@ def run_sweep_dispatches(
     ``get_kernel(layout, group)`` builds/caches the kernel for a shape class;
     ``run_kernel(kern, midstate, tail_const, bounds)`` queues one dispatch
     and returns its (not-yet-fetched) output handle;
-    ``consume(out, chunk_bases, 10^k)`` fetches and folds one result.
+    ``consume(out, chunk_bases, 10^k)`` fetches and folds one result — it
+    must also accept a :class:`HostFold` as ``out`` (with None bases):
+    digit classes with ``10^d <= host_lane_budget`` are min-folded on the
+    host instead of compiling a one-off kernel shape for a negligible lane
+    count.  0 (the default) disables routing so library callers and kernel
+    tests always exercise the device path; the miner's production pipeline
+    passes :func:`auto_host_lane_budget`.
     At most ``max_inflight`` dispatches stay queued — enough to keep the
     device busy while the host fills the next templates, while bounding host
     state for huge ranges (a 10^12-nonce sweep is ~10^6 dispatches on the
@@ -267,6 +316,13 @@ def run_sweep_dispatches(
     pending: Deque[Tuple] = collections.deque()
     lanes = 0
     for group in decompose_range(lower, upper, max_k=max_k):
+        if 10**group.d <= host_lane_budget:
+            g_lo = group.chunks[0].base + group.chunks[0].lo_off
+            g_hi = group.chunks[-1].base + group.chunks[-1].hi_off - 1
+            h, n = _host_min(data, g_lo, g_hi)
+            pending.append((HostFold(h, n), None, None))
+            lanes += sum(c.hi_off - c.lo_off for c in group.chunks)
+            continue
         layout = _layout_cache(data_bytes, group.d)
         kern = get_kernel(layout, group)
         midstate = np.array(layout.midstate, dtype=np.uint32)
@@ -344,6 +400,7 @@ class SweepPipeline:
         backend: Optional[str] = None,
         interpret: bool = False,
         max_inflight: int = 32,
+        host_lane_budget: Optional[int] = None,
     ) -> None:
         import queue as _queue
         import threading
@@ -354,7 +411,22 @@ class SweepPipeline:
         self._tile = tile
         self._cpb = cpb
         self._interpret = interpret
+        # None = auto: this is the miner's production path, where a tiny
+        # digit class must never cost a Mosaic compile (see HostFold).
+        self._host_lane_budget = (
+            auto_host_lane_budget() if host_lane_budget is None
+            else host_lane_budget
+        )
         self._rolled = not is_tpu()
+        self._prewarmed: set = set()
+        self._prewarm_lock = threading.Lock()
+        # Single-flight warm-up per kernel class (keyed by the lru-cached
+        # kernel object): a class's first invocation traces ~9 s of Python
+        # and loads the executable (~5 s more) — if the prewarm thread and
+        # the dispatcher both hit a cold class, they must share ONE build
+        # (measured r5: the unsynchronized race re-traced the full 17 s in
+        # the dispatcher even though prewarm was seconds from finishing).
+        self._kernel_locks: dict = {}
         self._jobs: "_queue.Queue" = _queue.Queue()
         # Backpressure: bounds both host memory and the device backlog.
         self._fetches: "_queue.Queue" = _queue.Queue(maxsize=max_inflight)
@@ -375,6 +447,69 @@ class SweepPipeline:
         fut = self._Future()
         self._jobs.put((data, lower, upper, fut))
         return fut
+
+    def prewarm_async(self, data: str, d: int) -> bool:
+        """Build + compile + device-load digit class ``d``'s kernel on a
+        background thread, overlapping the device's current work.
+
+        Why: each digit class is a distinct kernel shape, and its
+        first-in-process use costs ~9 s of Python tracing plus ~5 s of
+        executable load *even on a persistent-cache hit* (measured r5 on
+        the tunnelled v5e) — a mid-job stall if paid when the sweep first
+        crosses a digit boundary.  The miner calls this speculatively for
+        the class one past each assignment's upper bound.
+
+        Returns False without spawning when the class is host-routed
+        (see :class:`HostFold`), beyond u64's 20 digits, or already
+        prewarmed/warming.
+        """
+        import threading
+
+        if not 1 <= d <= 20:
+            return False
+        if 10**d <= self._host_lane_budget:
+            return False
+        # Kernel shape classes depend on the data LENGTH only (digit byte
+        # offset + tail block count), so same-length jobs share the warm —
+        # dedupe on length, not content, or every new job's data would
+        # re-run a ~0.5 s full-batch warm dispatch for a hot kernel.
+        key = (len(data.encode("utf-8")), d)
+        with self._prewarm_lock:
+            if key in self._prewarmed:
+                return False
+            self._prewarmed.add(key)
+        threading.Thread(
+            target=self._prewarm,
+            args=(data, d),
+            name=f"sweep-prewarm-d{d}",
+            daemon=True,
+        ).start()
+        return True
+
+    def _prewarm(self, data: str, d: int) -> None:
+        try:
+            rep = 10 ** (d - 1)  # any nonce in the class: (d, k) is all
+            group = next(decompose_range(rep, rep, max_k=self._max_k))
+            layout = _layout_cache(data.encode("utf-8"), group.d)
+            kern = self._get_kernel(layout, group)
+            midstate = np.array(layout.midstate, dtype=np.uint32)
+            tail_const, bounds = _fill_templates(
+                layout, group, group.chunks, self._batch
+            )
+            # One real (single-row, padded) dispatch: triggers trace +
+            # compile + load with exactly the shapes run_sweep_dispatches
+            # will use, so the dispatcher's later call is a pure cache hit.
+            # The class lock makes a racing dispatcher wait for this build
+            # instead of duplicating it.
+            with self._class_lock(kern):
+                out = _invoke_kernel(
+                    self._backend, kern, midstate, tail_const, bounds
+                )
+                for o in out:
+                    o.block_until_ready()
+        except Exception:
+            with self._prewarm_lock:  # let a later attempt retry
+                self._prewarmed.discard((len(data.encode("utf-8")), d))
 
     def close(self) -> None:
         self._closed = True
@@ -404,6 +539,15 @@ class SweepPipeline:
             group,
         )
 
+    def _class_lock(self, kern):
+        import threading
+
+        with self._prewarm_lock:
+            lk = self._kernel_locks.get(kern)
+            if lk is None:
+                lk = self._kernel_locks[kern] = threading.Lock()
+        return lk
+
     def _dispatch_loop(self) -> None:
         while True:
             item = self._jobs.get()
@@ -414,9 +558,14 @@ class SweepPipeline:
             state = {"best": [], "lanes": 0, "fut": fut}
 
             def run_kernel(kern, midstate, tail_const, bounds):
-                return _invoke_kernel(
-                    self._backend, kern, midstate, tail_const, bounds
-                )
+                # Class lock: a cold class traces inside this call; holding
+                # the lock shares that build with a concurrent prewarm of
+                # the same class.  Warm classes just enqueue (~ms) so the
+                # lock is uncontended in steady state.
+                with self._class_lock(kern):
+                    return _invoke_kernel(
+                        self._backend, kern, midstate, tail_const, bounds
+                    )
 
             def consume(out, bases, n_lanes) -> None:
                 # Blocks when max_inflight results are unfetched — that's
@@ -433,6 +582,7 @@ class SweepPipeline:
                     self._get_kernel,
                     run_kernel,
                     consume,
+                    host_lane_budget=self._host_lane_budget,
                 )
             except BaseException as e:  # resolve, don't kill the pipeline
                 self._fail(fut, e)
@@ -464,6 +614,12 @@ class SweepPipeline:
                 continue
             if fut.done():
                 continue  # job already failed; drain its remaining fetches
+            if isinstance(out, HostFold):
+                cand = (out.hash, out.nonce)
+                best = state["best"]
+                if not best or cand < best[0]:
+                    best[:] = [cand]
+                continue
             try:
                 h0, h1, flat_idx = out
                 fi = int(flat_idx)  # blocks until the dispatch lands
@@ -488,6 +644,7 @@ def sweep_min_hash(
     cpb: Optional[int] = None,
     backend: Optional[str] = None,
     interpret: bool = False,
+    host_lane_budget: int = 0,
 ) -> SweepResult:
     """Find ``(min Hash(data, n), argmin n)`` over inclusive ``[lower,
     upper]`` on the default JAX device.  Bit-exact vs the hashlib oracle
@@ -519,6 +676,11 @@ def sweep_min_hash(
     best: List[Tuple[int, int]] = []  # [(hash, nonce)] — current minimum
 
     def consume(out, bases, n_lanes):
+        if isinstance(out, HostFold):
+            cand = (out.hash, out.nonce)
+            if not best or cand < best[0]:
+                best[:] = [cand]
+            return
         h0, h1, flat_idx = out
         fi = int(flat_idx)
         if fi == I32_MAX:
@@ -529,7 +691,8 @@ def sweep_min_hash(
             best[:] = [cand]
 
     lanes = run_sweep_dispatches(
-        data, lower, upper, max_k, batch, get_kernel, run_kernel, consume
+        data, lower, upper, max_k, batch, get_kernel, run_kernel, consume,
+        host_lane_budget=host_lane_budget,
     )
     if not best:
         raise RuntimeError("sweep produced no candidates")

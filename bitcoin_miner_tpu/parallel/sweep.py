@@ -175,6 +175,13 @@ def sweep_min_hash_sharded(
     best: list = []
 
     def consume(out, bases, n_lanes):
+        from ..ops.sweep import HostFold
+
+        if isinstance(out, HostFold):
+            cand = (out.hash, out.nonce)
+            if not best or cand < best[0]:
+                best[:] = [cand]
+            return
         h0, h1, dev, flat = out
         if stats is not None:
             import time
