@@ -84,23 +84,25 @@ def _digit_contrib_np(
     )
 
 
-@functools.lru_cache(maxsize=256)
-def make_pallas_minhash(
+def _build_call(
     n_tail_blocks: int,
-    low_pos: Tuple[DigitPos, ...],
+    cwords: Tuple[int, ...],
     k: int,
-    batch: int = DEFAULT_BATCH,
-    tile: int = DEFAULT_TILE,
-    interpret: bool = False,
-    cpb: Optional[int] = None,
+    batch: int,
+    tile: int,
+    interpret: bool,
+    cpb: Optional[int],
 ):
-    """Build the jitted Pallas min-hash for one (layout, k, batch) class.
+    """Build the pallas_call shared by the static and dynamic factories.
 
-    Returned fn: ``(midstate (8,), tail_const (B, nw), bounds (B, 2))
-    -> (min_h0, min_h1, flat_idx)`` — the global lexicographic min over the
-    whole (B, 10^k) lane grid (hashes in the sign-flipped-int32 domain are
-    compared; outputs are plain uint32), flat_idx = chunk_row * 10^k + lane,
-    I32_MAX when every lane is masked out by bounds.
+    ``cwords``: the tail-word indices that receive a VMEM contribution
+    input (in input order).  The kernel body is identical either way —
+    contributions are pallas_call *inputs*; whether they are jit-trace
+    constants (static factory, one kernel per digit class) or runtime
+    arguments (dynamic factory, one kernel for every k=6 class) is decided
+    by the jit wrapper around the returned call.
+
+    Returns ``(call, n_pad)``.
     """
     n_lanes = 10**k
     if batch * n_lanes > I32_MAX:
@@ -117,7 +119,6 @@ def make_pallas_minhash(
     n_tiles = math.ceil(n_lanes / tile)
     n_pad = n_tiles * tile
     sub = tile // 128
-    cwords = _contrib_words(low_pos)
     word_to_cidx = {w: m for m, w in enumerate(cwords)}
 
     n_words = n_tail_blocks * 16
@@ -293,16 +294,126 @@ def make_pallas_minhash(
         scratch_shapes=[pltpu.VMEM((sub, 128), jnp.int32) for _ in range(3)],
         interpret=interpret,
     )
+    return call, n_pad
+
+
+def _unflip(h0b, h1b, idx):
+    """SMEM outputs -> (u32 h0, u32 h1, i32 flat_idx) scalars."""
+    sbit = jnp.uint32(0x80000000)
+    min_h0 = jax.lax.bitcast_convert_type(h0b[0], jnp.uint32) ^ sbit
+    min_h1 = jax.lax.bitcast_convert_type(h1b[0], jnp.uint32) ^ sbit
+    return min_h0, min_h1, idx[0]
+
+
+@functools.lru_cache(maxsize=256)
+def make_pallas_minhash(
+    n_tail_blocks: int,
+    low_pos: Tuple[DigitPos, ...],
+    k: int,
+    batch: int = DEFAULT_BATCH,
+    tile: int = DEFAULT_TILE,
+    interpret: bool = False,
+    cpb: Optional[int] = None,
+):
+    """Build the jitted Pallas min-hash for one (layout, k, batch) class.
+
+    Returned fn: ``(midstate (8,), tailc_bounds (B, nw+2))
+    -> (min_h0, min_h1, flat_idx)`` — the global lexicographic min over the
+    whole (B, 10^k) lane grid (hashes in the sign-flipped-int32 domain are
+    compared; outputs are plain uint32), flat_idx = chunk_row * 10^k + lane,
+    I32_MAX when every lane is masked out by bounds.
+    """
+    cwords = _contrib_words(low_pos)
+    call, n_pad = _build_call(
+        n_tail_blocks, cwords, k, batch, tile, interpret, cpb
+    )
 
     @jax.jit
     def minhash(midstate, tailc_bounds):
         contribs = tuple(
             jnp.asarray(c) for c in _digit_contrib_np(k, low_pos, n_pad)
         )
-        h0b, h1b, idx = call(midstate, tailc_bounds.reshape(-1), *contribs)
-        sbit = jnp.uint32(0x80000000)
-        min_h0 = jax.lax.bitcast_convert_type(h0b[0], jnp.uint32) ^ sbit
-        min_h1 = jax.lax.bitcast_convert_type(h1b[0], jnp.uint32) ^ sbit
-        return min_h0, min_h1, idx[0]
+        return _unflip(*call(midstate, tailc_bounds.reshape(-1), *contribs))
 
     return minhash
+
+
+def dyn_window(digit_off: int, n_words: int, k: int) -> Tuple[int, int]:
+    """The static word window ``[w_lo, w_hi]`` that can carry the k low
+    digits of ANY digit class d in [k+1, 20] (u64 max) for a message whose
+    digits start at tail byte ``digit_off``: low digits of class d occupy
+    bytes ``digit_off + d - k .. digit_off + d - 1``."""
+    w_lo = (digit_off + (k + 1) - k) // 4
+    w_hi = min((digit_off + 20 - 1) // 4, n_words - 1)
+    return w_lo, w_hi
+
+
+@functools.lru_cache(maxsize=64)
+def make_pallas_minhash_dyn(
+    n_tail_blocks: int,
+    w_lo: int,
+    w_hi: int,
+    k: int,
+    batch: int = DEFAULT_BATCH,
+    tile: int = DEFAULT_TILE,
+    interpret: bool = False,
+    cpb: Optional[int] = None,
+):
+    """Digit-position-DYNAMIC variant: one compiled kernel for every digit
+    class whose k low digits land in tail words ``[w_lo, w_hi]`` — i.e. all
+    d in [k+1, 20] sharing a tail-block count (see :func:`dyn_window`).
+
+    Why it exists: each digit class is otherwise a distinct kernel whose
+    first in-process use costs ~9 s of tracing + ~5 s of executable load
+    (even on a persistent-cache hit) — a mid-job stall whenever a sweep
+    crosses a decimal digit boundary (measured r5, fleet path in
+    BASELINE.md).  Here the per-class digit contributions become RUNTIME
+    inputs (one (n_pad/128, 128) u32 tile per window word, zero tiles for
+    untouched words), so every class shares one trace + one executable.
+
+    Cost vs the static kernel: window words are vector (OR with a zero
+    tile) even when the class leaves them constant, so some const-only
+    schedule chains move from the scalar unit to the VPU.
+
+    Returned fn: ``(midstate, tailc_bounds, *contribs)`` ->
+    ``(min_h0, min_h1, flat_idx)``; contribs must have length
+    ``w_hi - w_lo + 1`` (see :func:`window_contribs_np`).
+    """
+    cwords = tuple(range(w_lo, w_hi + 1))
+    call, n_pad = _build_call(
+        n_tail_blocks, cwords, k, batch, tile, interpret, cpb
+    )
+
+    @jax.jit
+    def minhash(midstate, tailc_bounds, *contribs):
+        return _unflip(*call(midstate, tailc_bounds.reshape(-1), *contribs))
+
+    return minhash, n_pad
+
+
+@functools.lru_cache(maxsize=8)
+def zero_tile_np(n_pad: int) -> np.ndarray:
+    """One shared all-zero contribution tile per lane-pad size — untouched
+    window words across every digit class alias it (and its single device
+    copy) instead of pinning a fresh ~4 MB buffer each."""
+    z = np.zeros((n_pad // 128, 128), dtype=np.uint32)
+    z.setflags(write=False)
+    return z
+
+
+@functools.lru_cache(maxsize=64)
+def window_contribs_np(
+    k: int, low_pos: Tuple[DigitPos, ...], w_lo: int, w_hi: int, n_pad: int
+) -> Tuple[np.ndarray, ...]:
+    """Per-window-word contribution tiles for one digit class, the shared
+    zero tile for window words this class's digits don't touch."""
+    for dp in low_pos:
+        if not w_lo <= dp.word <= w_hi:
+            raise ValueError(
+                f"digit word {dp.word} outside dyn window [{w_lo}, {w_hi}]"
+            )
+    per_word = dict(
+        zip(_contrib_words(low_pos), _digit_contrib_np(k, low_pos, n_pad))
+    )
+    zero = zero_tile_np(n_pad)
+    return tuple(per_word.get(w, zero) for w in range(w_lo, w_hi + 1))

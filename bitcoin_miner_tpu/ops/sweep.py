@@ -232,6 +232,11 @@ def auto_tune(
     if backend is None:
         backend = _default_backend()
     if batch is None:
+        # pallas: the r5 on-TPU autotune of the dynamic kernel prefers
+        # batch 2048 for FULL dispatches (1.907e9 vs 1.899e9 bench), but
+        # the fleet's EWMA chunks (~0.95e9 at target_chunk_seconds=0.5)
+        # half-fill a 2048-row batch and measured 1.79e9 delivered vs
+        # 1.82e9 at 1024 — the scheduler-matched 1024 wins end-to-end.
         # xla default measured via bench.py --autotune on XLA:CPU: batch 4
         # beat 8/16/32 by 14-128% (smaller schedule buffer, better cache).
         batch = 1024 if backend == "pallas" else 4
@@ -339,22 +344,84 @@ def run_sweep_dispatches(
     return lanes
 
 
+@lru_cache(maxsize=8)
+def _zero_tile_dev(n_pad):
+    from .pallas_sha256 import zero_tile_np
+
+    return jnp.asarray(zero_tile_np(n_pad))
+
+
+@lru_cache(maxsize=64)
+def _window_contribs_dev(k, low_pos, w_lo, w_hi, n_pad):
+    """Device-resident window contribution tiles for one digit class —
+    cached so repeated sweeps don't re-transfer them; untouched words
+    share one device zero tile across all classes."""
+    from .pallas_sha256 import window_contribs_np, zero_tile_np
+
+    zero = zero_tile_np(n_pad)
+    return tuple(
+        _zero_tile_dev(n_pad) if c is zero else jnp.asarray(c)
+        for c in window_contribs_np(k, low_pos, w_lo, w_hi, n_pad)
+    )
+
+
 def _build_kernel(backend, batch, tile, cpb, interpret, rolled, layout, group):
     """One place for the backend-specific kernel construction (shared by
-    the synchronous driver and SweepPipeline; both are lru_cached below)."""
+    the synchronous driver and SweepPipeline; the underlying factories are
+    lru_cached).
+
+    The pallas tier uses the digit-position-DYNAMIC kernel: one compiled
+    executable serves every digit class d in [k+1, 20] of this data length
+    (per-class contributions are runtime inputs), so crossing a decimal
+    digit boundary mid-sweep never costs a fresh ~14 s trace+load
+    (BASELINE.md fleet section).  The returned closure carries a stable
+    ``class_key`` (the shared jit fn) so SweepPipeline's single-flight
+    build locks key on the executable, not the per-class wrapper.
+    """
     low_pos = layout.digit_pos[layout.digit_count - group.k :]
     if backend == "pallas":
-        from .pallas_sha256 import DEFAULT_TILE, make_pallas_minhash
+        from .pallas_sha256 import (
+            DEFAULT_TILE,
+            dyn_window,
+            make_pallas_minhash,
+            make_pallas_minhash_dyn,
+        )
 
-        return make_pallas_minhash(
+        dp0 = layout.digit_pos[0]
+        digit_off = dp0.word * 4 + (3 - dp0.shift // 8)
+        w_lo, w_hi = dyn_window(
+            digit_off, layout.n_tail_blocks * 16, group.k
+        )
+        if not all(w_lo <= dp.word <= w_hi for dp in low_pos):
+            # The d=1 class has d == k (its lone digit byte sits one short
+            # of the d >= k+1 window); it is one class, so the dynamic
+            # kernel buys nothing — use the per-class static form.
+            return make_pallas_minhash(
+                layout.n_tail_blocks,
+                low_pos,
+                group.k,
+                batch,
+                tile=tile if tile is not None else DEFAULT_TILE,
+                interpret=interpret,
+                cpb=cpb,
+            )
+        fn, n_pad = make_pallas_minhash_dyn(
             layout.n_tail_blocks,
-            low_pos,
+            w_lo,
+            w_hi,
             group.k,
             batch,
             tile=tile if tile is not None else DEFAULT_TILE,
             interpret=interpret,
             cpb=cpb,
         )
+        contribs = _window_contribs_dev(group.k, low_pos, w_lo, w_hi, n_pad)
+
+        def kern(midstate, tailc_bounds, _fn=fn, _c=contribs):
+            return _fn(midstate, tailc_bounds, *_c)
+
+        kern.class_key = fn
+        return kern
     return _make_kernel(layout.n_tail_blocks, low_pos, group.k, batch, rolled)
 
 
@@ -427,6 +494,7 @@ class SweepPipeline:
         # (measured r5: the unsynchronized race re-traced the full 17 s in
         # the dispatcher even though prewarm was seconds from finishing).
         self._kernel_locks: dict = {}
+        self._warm_keys: set = set()
         self._jobs: "_queue.Queue" = _queue.Queue()
         # Backpressure: bounds both host memory and the device backlog.
         self._fetches: "_queue.Queue" = _queue.Queue(maxsize=max_inflight)
@@ -496,17 +564,25 @@ class SweepPipeline:
             tail_const, bounds = _fill_templates(
                 layout, group, group.chunks, self._batch
             )
+            # With the dynamic kernel, neighbouring digit classes share one
+            # executable — skip the warm dispatch if it's already hot.
+            key = getattr(kern, "class_key", kern)
+            if key in self._warm_keys:
+                return
             # One real (single-row, padded) dispatch: triggers trace +
             # compile + load with exactly the shapes run_sweep_dispatches
             # will use, so the dispatcher's later call is a pure cache hit.
             # The class lock makes a racing dispatcher wait for this build
             # instead of duplicating it.
             with self._class_lock(kern):
+                if key in self._warm_keys:
+                    return
                 out = _invoke_kernel(
                     self._backend, kern, midstate, tail_const, bounds
                 )
                 for o in out:
                     o.block_until_ready()
+                self._warm_keys.add(key)
         except Exception:
             with self._prewarm_lock:  # let a later attempt retry
                 self._prewarmed.discard((len(data.encode("utf-8")), d))
@@ -542,10 +618,11 @@ class SweepPipeline:
     def _class_lock(self, kern):
         import threading
 
+        key = getattr(kern, "class_key", kern)
         with self._prewarm_lock:
-            lk = self._kernel_locks.get(kern)
+            lk = self._kernel_locks.get(key)
             if lk is None:
-                lk = self._kernel_locks[kern] = threading.Lock()
+                lk = self._kernel_locks[key] = threading.Lock()
         return lk
 
     def _dispatch_loop(self) -> None:
@@ -563,9 +640,11 @@ class SweepPipeline:
                 # the same class.  Warm classes just enqueue (~ms) so the
                 # lock is uncontended in steady state.
                 with self._class_lock(kern):
-                    return _invoke_kernel(
+                    out = _invoke_kernel(
                         self._backend, kern, midstate, tail_const, bounds
                     )
+                    self._warm_keys.add(getattr(kern, "class_key", kern))
+                    return out
 
             def consume(out, bases, n_lanes) -> None:
                 # Blocks when max_inflight results are unfetched — that's
