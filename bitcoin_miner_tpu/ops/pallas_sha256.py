@@ -338,6 +338,23 @@ def make_pallas_minhash(
     return minhash
 
 
+def dyn_params(layout, k: int) -> Optional[Tuple[int, int]]:
+    """``(w_lo, w_hi)`` of the dynamic kernel's word window for this
+    layout's data length, or None when the (d, k) class lies outside the
+    dyn domain (d == k — the d=1 class, whose lone digit byte sits one
+    short of the d >= k+1 window).  The ONE eligibility predicate shared
+    by the single-device driver, the sharded driver, and the AOT test —
+    duplicating it risks the drivers silently diverging on kernel
+    selection."""
+    dp0 = layout.digit_pos[0]
+    digit_off = dp0.word * 4 + (3 - dp0.shift // 8)
+    w_lo, w_hi = dyn_window(digit_off, layout.n_tail_blocks * 16, k)
+    low_pos = layout.digit_pos[layout.digit_count - k :]
+    if all(w_lo <= dp.word <= w_hi for dp in low_pos):
+        return w_lo, w_hi
+    return None
+
+
 def dyn_window(digit_off: int, n_words: int, k: int) -> Tuple[int, int]:
     """The static word window ``[w_lo, w_hi]`` that can carry the k low
     digits of ANY digit class d in [k+1, 20] (u64 max) for a message whose

@@ -382,20 +382,15 @@ def _build_kernel(backend, batch, tile, cpb, interpret, rolled, layout, group):
     if backend == "pallas":
         from .pallas_sha256 import (
             DEFAULT_TILE,
-            dyn_window,
+            dyn_params,
             make_pallas_minhash,
             make_pallas_minhash_dyn,
         )
 
-        dp0 = layout.digit_pos[0]
-        digit_off = dp0.word * 4 + (3 - dp0.shift // 8)
-        w_lo, w_hi = dyn_window(
-            digit_off, layout.n_tail_blocks * 16, group.k
-        )
-        if not all(w_lo <= dp.word <= w_hi for dp in low_pos):
-            # The d=1 class has d == k (its lone digit byte sits one short
-            # of the d >= k+1 window); it is one class, so the dynamic
-            # kernel buys nothing — use the per-class static form.
+        window = dyn_params(layout, group.k)
+        if window is None:
+            # The d == k class (d=1) is one class — the dynamic kernel
+            # buys nothing; use the per-class static form.
             return make_pallas_minhash(
                 layout.n_tail_blocks,
                 low_pos,
@@ -405,6 +400,7 @@ def _build_kernel(backend, batch, tile, cpb, interpret, rolled, layout, group):
                 interpret=interpret,
                 cpb=cpb,
             )
+        w_lo, w_hi = window
         fn, n_pad = make_pallas_minhash_dyn(
             layout.n_tail_blocks,
             w_lo,

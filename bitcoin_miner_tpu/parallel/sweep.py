@@ -68,7 +68,8 @@ def _make_sharded_kernel(
     interpret: bool,
     rolled: bool,
 ):
-    """Compile the sharded kernel for one (layout, k, batch) shape class.
+    """Compile the sharded kernel for one (layout, k, batch) shape class
+    (the xla tier, and the pallas static fallback for the d == k class).
 
     Returned jitted fn: ``(midstate (8,), tail_const (B, nw), bounds (B, 2))
     -> (g_h0, g_h1, g_dev, g_flat)`` replicated scalars, where
@@ -106,6 +107,75 @@ def _make_sharded_kernel(
         check_vma=False,
     )
     return jax.jit(mapped)
+
+
+@lru_cache(maxsize=8)
+def _zero_tile_mesh(n_pad: int, mesh: Mesh):
+    from ..ops.pallas_sha256 import zero_tile_np
+
+    return jax.device_put(
+        zero_tile_np(n_pad), NamedSharding(mesh, P(None, None))
+    )
+
+
+@lru_cache(maxsize=64)
+def _mesh_contribs(k, low_pos, w_lo, w_hi, n_pad, mesh):
+    """Window contribution tiles replicated over the mesh, cached per
+    digit class so sweeps don't re-transfer them; untouched words share
+    one replicated zero tile."""
+    from ..ops.pallas_sha256 import window_contribs_np, zero_tile_np
+
+    rep = NamedSharding(mesh, P(None, None))
+    zero = zero_tile_np(n_pad)
+    return tuple(
+        _zero_tile_mesh(n_pad, mesh) if c is zero else jax.device_put(c, rep)
+        for c in window_contribs_np(k, low_pos, w_lo, w_hi, n_pad)
+    )
+
+
+@lru_cache(maxsize=64)
+def _make_sharded_kernel_dyn(
+    n_tail_blocks: int,
+    w_lo: int,
+    w_hi: int,
+    k: int,
+    per_dev_batch: int,
+    mesh: Mesh,
+    axis_name: str,
+    interpret: bool,
+):
+    """Sharded form of the digit-position-DYNAMIC pallas kernel: ONE
+    compiled SPMD executable serves every digit class d in [k+1, 20] of a
+    data length, same as the single-device production path (ops/sweep.py
+    `_build_kernel`) — a multi-chip sweep crossing a decimal digit
+    boundary never re-traces or re-loads.
+
+    Returned jitted fn: ``(midstate, tail_const, bounds, *contribs)`` with
+    contribs replicated (one (n_pad/128, 128) u32 tile per window word).
+    """
+    from ..ops.pallas_sha256 import make_pallas_minhash_dyn
+
+    pallas_fn, n_pad = make_pallas_minhash_dyn(
+        n_tail_blocks, w_lo, w_hi, k, per_dev_batch, interpret=interpret
+    )
+    n_window = w_hi - w_lo + 1
+
+    def shard_fn(midstate, tail_const, bounds, *contribs):
+        tailcb = jnp.concatenate(
+            [tail_const, bounds.astype(jnp.uint32)], axis=1
+        )
+        h0, h1, flat = pallas_fn(midstate, tailcb, *contribs)
+        return _collective_min(h0, h1, flat, axis_name)
+
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(axis_name, None), P(axis_name, None))
+        + (P(None, None),) * n_window,
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,  # same rationale as the static form above
+    )
+    return jax.jit(mapped), n_pad
 
 
 def sweep_min_hash_sharded(
@@ -148,6 +218,32 @@ def sweep_min_hash_sharded(
 
     def get_kernel(layout, group):
         low_pos = layout.digit_pos[layout.digit_count - group.k :]
+        if backend == "pallas":
+            from ..ops.pallas_sha256 import dyn_params
+
+            window = dyn_params(layout, group.k)
+            if window is not None:
+                w_lo, w_hi = window
+                fn, n_pad = _make_sharded_kernel_dyn(
+                    layout.n_tail_blocks,
+                    w_lo,
+                    w_hi,
+                    group.k,
+                    batch_per_device,
+                    mesh,
+                    axis_name,
+                    interpret,
+                )
+                contribs = _mesh_contribs(
+                    group.k, low_pos, w_lo, w_hi, n_pad, mesh
+                )
+
+                def kern(midstate, tail_const, bounds, _fn=fn, _c=contribs):
+                    return _fn(midstate, tail_const, bounds, *_c)
+
+                return kern
+            # d == k (the d=1 class): outside the dyn window domain; one
+            # class, so per-class compilation costs nothing extra.
         return _make_sharded_kernel(
             layout.n_tail_blocks,
             low_pos,

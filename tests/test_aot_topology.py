@@ -26,9 +26,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bitcoin_miner_tpu.ops.pallas_sha256 import dyn_params
 from bitcoin_miner_tpu.ops.sha256 import build_layout
 from bitcoin_miner_tpu.ops.sweep import decompose_range
-from bitcoin_miner_tpu.parallel.sweep import _make_sharded_kernel
+from bitcoin_miner_tpu.parallel.sweep import (
+    _make_sharded_kernel,
+    _make_sharded_kernel_dyn,
+)
 
 
 @pytest.fixture(scope="module")
@@ -47,33 +51,40 @@ def v5e_mesh():
 
 
 def test_flagship_sharded_pallas_aot_compiles_v5e8(v5e_mesh):
-    # Flagship shape class: d=10 digits, k=6 (10^6-lane chunks), per-device
-    # batch 1024 — the pallas-tier auto_tune defaults used on real chips.
+    # The PRODUCTION flagship config: the digit-position-dynamic kernel
+    # (one executable for all d in [7, 20]), k=6 (10^6-lane chunks),
+    # per-device batch 1024 — exactly what sweep_min_hash_sharded builds
+    # on real chips.
     data = b"bitcoin"
     group = next(decompose_range(10**9, 10**9 + 10**8, max_k=6))
     layout = build_layout(data, group.d)
-    low_pos = layout.digit_pos[layout.digit_count - group.k :]
+    w_lo, w_hi = dyn_params(layout, group.k)
     per_dev_batch = 1024
-    kern = _make_sharded_kernel(
+    kern, n_pad = _make_sharded_kernel_dyn(
         layout.n_tail_blocks,
-        low_pos,
+        w_lo,
+        w_hi,
         group.k,
         per_dev_batch,
         v5e_mesh,
         "miners",
-        "pallas",
         False,  # interpret=False: real Mosaic lowering
-        False,
     )
 
     nw = len(layout.tail_template)
     B = 8 * per_dev_batch
     row = NamedSharding(v5e_mesh, P("miners", None))
     rep = NamedSharding(v5e_mesh, P())
+    rep2 = NamedSharding(v5e_mesh, P(None, None))
+    contribs = tuple(
+        jax.ShapeDtypeStruct((n_pad // 128, 128), jnp.uint32, sharding=rep2)
+        for _ in range(w_hi - w_lo + 1)
+    )
     lowered = kern.lower(
         jax.ShapeDtypeStruct((8,), jnp.uint32, sharding=rep),
         jax.ShapeDtypeStruct((B, nw), jnp.uint32, sharding=row),
         jax.ShapeDtypeStruct((B, 2), jnp.int32, sharding=row),
+        *contribs,
     )
     compiled = lowered.compile()
 
@@ -88,3 +99,42 @@ def test_flagship_sharded_pallas_aot_compiles_v5e8(v5e_mesh):
     )
     # Outputs are the four replicated scalars of the collective min.
     assert len(compiled.output_shardings) == 4
+
+
+def test_static_fallback_sharded_pallas_aot_compiles_v5e8(v5e_mesh):
+    # The per-class static form must also partition + Mosaic-compile for
+    # the v5e-8 target — built for a class production actually routes to
+    # it: d == k = 1 with the digit byte one below the window (needs
+    # digit_off % 4 == 3, i.e. len(data) % 4 == 2 — 'cmu440'; for most
+    # data lengths even d=1 is dyn-eligible).
+    data = b"cmu440"
+    group = next(decompose_range(1, 9, max_k=6))
+    layout = build_layout(data, group.d)
+    assert group.d == group.k, "fallback test must use the d == k class"
+    assert dyn_params(layout, group.k) is None, (
+        "production routes this class to the static kernel"
+    )
+    low_pos = layout.digit_pos[layout.digit_count - group.k :]
+    per_dev_batch = 1024
+    kern = _make_sharded_kernel(
+        layout.n_tail_blocks,
+        low_pos,
+        group.k,
+        per_dev_batch,
+        v5e_mesh,
+        "miners",
+        "pallas",
+        False,
+        False,
+    )
+    nw = len(layout.tail_template)
+    B = 8 * per_dev_batch
+    row = NamedSharding(v5e_mesh, P("miners", None))
+    rep = NamedSharding(v5e_mesh, P())
+    compiled = kern.lower(
+        jax.ShapeDtypeStruct((8,), jnp.uint32, sharding=rep),
+        jax.ShapeDtypeStruct((B, nw), jnp.uint32, sharding=row),
+        jax.ShapeDtypeStruct((B, 2), jnp.int32, sharding=row),
+    ).compile()
+    txt = compiled.as_text()
+    assert "all-reduce" in txt and "tpu_custom_call" in txt
