@@ -447,12 +447,14 @@ def main() -> int:
 
     n = 4 * 10**6
     dt = timed(n)
-    # Grow until the measurement window is solid (caps at ~8e9 nonces; at
-    # ~1.85e9 n/s the fixed lead-in dispatch + trailing fetch through the
-    # tunnel is ~45 ms, so a 4e9 window under-reports steady state by ~2%
-    # and 8e9 by ~1%).
-    while dt < 4.0 and n < 8 * 10**9:
-        n = min(n * max(2, int(4.0 / max(dt, 1e-3))), 8 * 10**9)
+    # Grow until the measurement window is solid (caps at ~1.6e10 nonces).
+    # The r5 trace (benchmarks/traces/r5_dyn_8e9) shows dispatches run
+    # back-to-back with zero device gaps at an in-device 2.04e9 n/s; the
+    # only non-steady-state cost is the tunnel's fixed ~0.19 s
+    # lead-in + trailing fetch, which an 8e9 window reports as ~-4.5%
+    # and a 1.6e10 window as ~-2%.
+    while dt < 7.5 and n < 16 * 10**9:
+        n = min(n * max(2, int(7.5 / max(dt, 1e-3))), 16 * 10**9)
         dt = timed(n)
     if args.profile:
         with jax.profiler.trace(args.profile):

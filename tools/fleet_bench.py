@@ -189,7 +189,7 @@ def main() -> int:
     ap.add_argument(
         "--kernel-rate",
         type=float,
-        default=1.899e9,
+        default=1.947e9,
         help="single-chip kernel rate to compare against (BENCH_r05)",
     )
     ap.add_argument("--port", type=int, default=0)
