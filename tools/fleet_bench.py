@@ -120,9 +120,9 @@ class MinerKeeper:
             return False
         return (now - self._progress_at) < stall_timeout
 
-    def restart(self) -> None:
+    def restart(self, reason: str = "wedged/dead") -> None:
         self.restarts += 1
-        log(f"miner wedged/dead; restart #{self.restarts}")
+        log(f"miner {reason}; restart #{self.restarts}")
         self.kill()
         time.sleep(2.0)  # let the tunnel release the previous client
         self.spawn()
@@ -139,10 +139,15 @@ class MinerKeeper:
 
 def run_job(
     client, keeper: MinerKeeper, data: str, max_nonce: int, deadline: float,
-    stall: float, lower: int = 0,
+    stall: float, lower: int = 0, kill_after: float = 0.0,
 ) -> dict:
     """Submit one Request; wait for the Result with the keeper watching the
-    miner.  Validates the Result against the hashlib per-nonce oracle."""
+    miner.  Validates the Result against the hashlib per-nonce oracle.
+
+    ``kill_after`` > 0: SIGKILL the miner that many seconds into the job
+    and respawn it — the fault-injection leg of the kill drill; the
+    scheduler's dead-conn reassignment must carry the job to the same
+    answer."""
     t0 = time.monotonic()
     client.write(Message.request(data, lower, max_nonce).marshal())
     box: list = []
@@ -155,8 +160,14 @@ def run_job(
 
     reader = threading.Thread(target=_read, daemon=True)
     reader.start()
+    kill_fired = False
     while reader.is_alive():
-        reader.join(timeout=5.0)
+        armed = kill_after > 0.0 and not kill_fired
+        reader.join(timeout=0.5 if armed else 5.0)
+        if armed and time.monotonic() - t0 >= kill_after:
+            log(f"kill drill: SIGKILL miner at t+{kill_after:.1f}s")
+            keeper.restart(reason="kill drill")  # scheduler must reassign
+            kill_fired = True
         if reader.is_alive():
             if time.monotonic() - t0 > deadline:
                 raise RuntimeError(f"job exceeded {deadline:.0f}s deadline")
@@ -174,9 +185,14 @@ def run_job(
     # scheduler already hashlib-validates every chunk Result, and the
     # kernel tiers are oracle-tested.  Assert the returned pair is at
     # least a real in-range hash of the job.
-    assert 0 <= msg.nonce <= max_nonce, (msg.nonce, max_nonce)
+    assert lower <= msg.nonce <= max_nonce, (msg.nonce, lower, max_nonce)
     assert hash_nonce(data, msg.nonce) == msg.hash, (msg.hash, msg.nonce)
-    return {"wall_s": dt, "hash": msg.hash, "nonce": msg.nonce}
+    return {
+        "wall_s": dt,
+        "hash": msg.hash,
+        "nonce": msg.nonce,
+        "kill_fired": kill_fired,
+    }
 
 
 def main() -> int:
@@ -192,6 +208,15 @@ def main() -> int:
         default=1.947e9,
         help="single-chip kernel rate to compare against (BENCH_r05)",
     )
+    ap.add_argument(
+        "--kill-drill",
+        action="store_true",
+        help="after the timed job, run one job clean and the same job with "
+        "a mid-job miner SIGKILL+respawn; assert both return the identical "
+        "(hash, nonce) — the scheduler's reassignment invariant on the "
+        "real fleet",
+    )
+    ap.add_argument("--drill-nonces", type=int, default=6 * 10**9)
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument(
@@ -248,9 +273,14 @@ def main() -> int:
         # class pays that cost here instead of mid-measurement.  The
         # mid-job path is still covered: the miner prewarms one class
         # ahead of each assignment (SweepPipeline.prewarm_async).
-        for d in range(len(str(args.warmup - 1)) + 1, len(str(args.nonces - 1)) + 1):
+        # The drill range sits beyond the timed job; its digit classes must
+        # be warm too, or the "clean" drill leg absorbs a first-use build.
+        top = args.nonces - 1
+        if args.kill_drill:
+            top = args.nonces + args.drill_nonces - 1
+        for d in range(len(str(args.warmup - 1)) + 1, len(str(top)) + 1):
             t0 = time.monotonic()
-            hi = min(10**d - 1, args.nonces - 1)
+            hi = min(10**d - 1, top)
             run_job(
                 client, keeper, data, hi, args.timeout, args.stall,
                 lower=max(0, hi - 10**6 + 1),
@@ -265,6 +295,47 @@ def main() -> int:
             f"fleet delivered {rate / 1e9:.3f}e9 n/s over {timed['wall_s']:.2f}s "
             f"({rate / args.kernel_rate:.1%} of the {args.kernel_rate / 1e9:.3f}e9 kernel rate)"
         )
+        drill = None
+        if args.kill_drill:
+            # Same range, clean vs mid-job miner SIGKILL: the argmin over a
+            # fixed range is deterministic, so any correct execution —
+            # including one the scheduler had to reassemble from a dead
+            # miner's reassigned chunks — must return the identical pair.
+            d_lo = args.nonces  # fresh range, beyond the timed job
+            d_hi = d_lo + args.drill_nonces - 1
+            restarts_before = keeper.restarts
+            log(f"kill drill: clean job over [{d_lo},{d_hi}]")
+            clean = run_job(
+                client, keeper, data, d_hi, args.timeout, args.stall,
+                lower=d_lo,
+            )
+            kill_at = max(1.0, 0.4 * clean["wall_s"])
+            log(f"kill drill: same job, SIGKILL at t+{kill_at:.1f}s")
+            killed = run_job(
+                client, keeper, data, d_hi, args.timeout, args.stall,
+                lower=d_lo, kill_after=kill_at,
+            )
+            if not killed["kill_fired"]:
+                # A Result that lands before the kill makes the drill a
+                # second clean run — no fault-tolerance evidence at all.
+                raise RuntimeError(
+                    "kill drill: Result arrived before the SIGKILL fired; "
+                    "raise --drill-nonces"
+                )
+            match = (clean["hash"], clean["nonce"]) == (
+                killed["hash"], killed["nonce"],
+            )
+            drill = {
+                "match": match,
+                "hash": clean["hash"],
+                "nonce": clean["nonce"],
+                "clean_wall_s": round(clean["wall_s"], 3),
+                "killed_wall_s": round(killed["wall_s"], 3),
+                "drill_restarts": keeper.restarts - restarts_before,
+            }
+            log(f"kill drill: match={match} ({clean} vs {killed})")
+            if not match:
+                raise RuntimeError(f"kill drill mismatch: {clean} vs {killed}")
         print(
             json.dumps(
                 {
@@ -278,8 +349,12 @@ def main() -> int:
                     "wall_s": round(timed["wall_s"], 3),
                     "warmup_nonces": args.warmup,
                     "warmup_wall_s": round(warm["wall_s"], 3),
-                    "miner_restarts": keeper.restarts,
+                    # Involuntary (wedge/death) recoveries only; the
+                    # drill's deliberate kill is counted in kill_drill.
+                    "miner_restarts": keeper.restarts
+                    - (drill["drill_restarts"] if drill else 0),
                     "backend": args.backend,
+                    **({"kill_drill": drill} if drill is not None else {}),
                 }
             ),
             flush=True,
