@@ -1,0 +1,63 @@
+"""Smoke tests for tools/fleet_bench.py — the tool itself, on CPU tiers.
+
+The real artifact runs on the TPU (`benchmarks/fleet_r05*.json`); here the
+same harness drives real server+miner subprocesses on loopback with tiny
+jobs, so regressions in the tool (job plumbing, class-warm loop, kill
+drill arming/validation) fail in CI rather than at bench time.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _run_fleet(args, timeout):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "fleet_bench.py"), *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=str(REPO),
+    )
+
+
+@pytest.mark.slow
+def test_fleet_bench_smoke_cpu():
+    # Native C++ tier (~1.9e7 n/s): a 3e7 job finishes in seconds.
+    p = _run_fleet(
+        ["--backend", "cpu", "--nonces", "30000000", "--warmup", "2000000",
+         "--timeout", "120", "--stall", "30"],
+        timeout=240,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "fleet_nonces_per_sec"
+    assert out["nonces"] == 30000000
+    assert out["value"] > 0
+    assert out["miner_restarts"] == 0, p.stderr[-2000:]
+
+
+@pytest.mark.slow
+def test_fleet_bench_kill_drill_cpu():
+    # Drill sized so the clean job takes seconds — the SIGKILL provably
+    # fires mid-job (the tool raises if the Result beats the kill).
+    p = _run_fleet(
+        ["--backend", "cpu", "--nonces", "20000000", "--warmup", "2000000",
+         "--kill-drill", "--drill-nonces", "60000000",
+         "--timeout", "180", "--stall", "30"],
+        timeout=360,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    drill = out["kill_drill"]
+    assert drill["match"] is True
+    assert drill["deliberate_kills"] == 1
+    assert out["miner_restarts"] == 0  # deliberate kills counted separately

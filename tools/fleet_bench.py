@@ -164,7 +164,11 @@ def run_job(
     while reader.is_alive():
         armed = kill_after > 0.0 and not kill_fired
         reader.join(timeout=0.5 if armed else 5.0)
-        if armed and time.monotonic() - t0 >= kill_after:
+        if (
+            armed
+            and reader.is_alive()  # a post-Result kill proves nothing
+            and time.monotonic() - t0 >= kill_after
+        ):
             log(f"kill drill: SIGKILL miner at t+{kill_after:.1f}s")
             keeper.restart(reason="kill drill")  # scheduler must reassign
             kill_fired = True
@@ -303,7 +307,6 @@ def main() -> int:
             # miner's reassigned chunks — must return the identical pair.
             d_lo = args.nonces  # fresh range, beyond the timed job
             d_hi = d_lo + args.drill_nonces - 1
-            restarts_before = keeper.restarts
             log(f"kill drill: clean job over [{d_lo},{d_hi}]")
             clean = run_job(
                 client, keeper, data, d_hi, args.timeout, args.stall,
@@ -331,7 +334,11 @@ def main() -> int:
                 "nonce": clean["nonce"],
                 "clean_wall_s": round(clean["wall_s"], 3),
                 "killed_wall_s": round(killed["wall_s"], 3),
-                "drill_restarts": keeper.restarts - restarts_before,
+                # Exactly one deliberate kill per drill (kill_fired was
+                # asserted above); any further restarts in the drill window
+                # were involuntary wedge recoveries and stay in
+                # miner_restarts.
+                "deliberate_kills": 1,
             }
             log(f"kill drill: match={match} ({clean} vs {killed})")
             if not match:
@@ -352,7 +359,7 @@ def main() -> int:
                     # Involuntary (wedge/death) recoveries only; the
                     # drill's deliberate kill is counted in kill_drill.
                     "miner_restarts": keeper.restarts
-                    - (drill["drill_restarts"] if drill else 0),
+                    - (drill["deliberate_kills"] if drill else 0),
                     "backend": args.backend,
                     **({"kill_drill": drill} if drill is not None else {}),
                 }
