@@ -17,6 +17,22 @@ import pytest
 REPO = Path(__file__).resolve().parents[1]
 
 
+def _cpu_rate() -> float:
+    """Measured nonces/s of the cpu-backend sweep on THIS host (native if
+    it builds, else hashlib) — job sizes scale with it so the smoke tests
+    neither race a fast CI box nor crawl on a g++-less one."""
+    import time
+
+    from bitcoin_miner_tpu.apps.miner import make_search
+
+    sweep = make_search("cpu")
+    n = 200_000
+    t0 = time.perf_counter()
+    sweep("ratecal", 0, n - 1)
+    dt = time.perf_counter() - t0
+    return max(n / dt, 1e5)
+
+
 def _run_fleet(args, timeout):
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     return subprocess.run(
@@ -31,27 +47,32 @@ def _run_fleet(args, timeout):
 
 @pytest.mark.slow
 def test_fleet_bench_smoke_cpu():
-    # Native C++ tier (~1.9e7 n/s): a 3e7 job finishes in seconds.
+    # ~2 s of cpu-tier work, whatever this host's rate is.
+    nonces = int(_cpu_rate() * 2)
     p = _run_fleet(
-        ["--backend", "cpu", "--nonces", "30000000", "--warmup", "2000000",
+        ["--backend", "cpu", "--nonces", str(nonces),
+         "--warmup", str(max(nonces // 15, 10**5)),
          "--timeout", "120", "--stall", "30"],
         timeout=240,
     )
     assert p.returncode == 0, p.stderr[-2000:]
     out = json.loads(p.stdout.strip().splitlines()[-1])
     assert out["metric"] == "fleet_nonces_per_sec"
-    assert out["nonces"] == 30000000
+    assert out["nonces"] == nonces
     assert out["value"] > 0
     assert out["miner_restarts"] == 0, p.stderr[-2000:]
 
 
 @pytest.mark.slow
 def test_fleet_bench_kill_drill_cpu():
-    # Drill sized so the clean job takes seconds — the SIGKILL provably
-    # fires mid-job (the tool raises if the Result beats the kill).
+    # Drill sized to ~6 s of clean sweep on this host, so the SIGKILL
+    # (kill_at >= 1 s) provably fires mid-job even on a fast CI box —
+    # the tool raises if the Result beats the kill.
+    rate = _cpu_rate()
     p = _run_fleet(
-        ["--backend", "cpu", "--nonces", "20000000", "--warmup", "2000000",
-         "--kill-drill", "--drill-nonces", "60000000",
+        ["--backend", "cpu", "--nonces", str(int(rate)),
+         "--warmup", str(max(int(rate) // 15, 10**5)),
+         "--kill-drill", "--drill-nonces", str(int(rate * 6)),
          "--timeout", "180", "--stall", "30"],
         timeout=360,
     )

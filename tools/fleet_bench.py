@@ -213,6 +213,15 @@ def main() -> int:
         help="single-chip kernel rate to compare against (BENCH_r05)",
     )
     ap.add_argument(
+        "--cpu-miners",
+        type=int,
+        default=0,
+        help="spawn this many additional native C++ (--backend cpu) miners "
+        "alongside the main miner — the heterogeneous fleet of "
+        "BASELINE.json:9 on real hardware; the scheduler range-splits "
+        "across all workers and min-folds their Results",
+    )
+    ap.add_argument(
         "--kill-drill",
         action="store_true",
         help="after the timed job, run one job clean and the same job with "
@@ -244,6 +253,7 @@ def main() -> int:
     server = None
     keeper = None
     client = None
+    cpu_miners: list = []
     try:
         server = subprocess.Popen(
             [sys.executable, "-m", "bitcoin_miner_tpu.apps.server", str(port)],
@@ -256,6 +266,25 @@ def main() -> int:
         _wait_listening(server, 30)
         log(f"server up on :{port}; miner log -> {miner_log}")
         keeper = MinerKeeper(port, args.backend, miner_log)
+        for i in range(args.cpu_miners):
+            cpu_log = open(os.path.join(tmp, f"cpu_miner_{i}.log"), "wb")
+            cpu_miners.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        "-m",
+                        "bitcoin_miner_tpu.apps.miner",
+                        f"127.0.0.1:{port}",
+                        "--backend",
+                        "cpu",
+                    ],
+                    cwd=str(REPO),
+                    stdout=subprocess.DEVNULL,
+                    stderr=cpu_log,
+                )
+            )
+        if cpu_miners:
+            log(f"spawned {len(cpu_miners)} native cpu miners (logs in {tmp})")
 
         from bitcoin_miner_tpu import lsp
 
@@ -299,6 +328,14 @@ def main() -> int:
             f"fleet delivered {rate / 1e9:.3f}e9 n/s over {timed['wall_s']:.2f}s "
             f"({rate / args.kernel_rate:.1%} of the {args.kernel_rate / 1e9:.3f}e9 kernel rate)"
         )
+        # A cpu miner that died mid-bench would make the "heterogeneous
+        # fleet" artifact describe a fleet that never ran — refuse.
+        dead = [i for i, m in enumerate(cpu_miners) if m.poll() is not None]
+        if dead:
+            raise RuntimeError(
+                f"cpu miner(s) {dead} died during the bench; see "
+                f"{tmp}/cpu_miner_*.log"
+            )
         drill = None
         if args.kill_drill:
             # Same range, clean vs mid-job miner SIGKILL: the argmin over a
@@ -361,6 +398,11 @@ def main() -> int:
                     "miner_restarts": keeper.restarts
                     - (drill["deliberate_kills"] if drill else 0),
                     "backend": args.backend,
+                    **(
+                        {"cpu_miners": args.cpu_miners}
+                        if args.cpu_miners
+                        else {}
+                    ),
                     **({"kill_drill": drill} if drill is not None else {}),
                 }
             ),
@@ -375,6 +417,9 @@ def main() -> int:
                 pass
         if keeper is not None:
             keeper.kill()
+        for m in cpu_miners:
+            if m.poll() is None:
+                m.send_signal(signal.SIGKILL)
         if server is not None and server.poll() is None:
             server.send_signal(signal.SIGTERM)
             try:
