@@ -119,11 +119,22 @@ def count_vector_ops(data: str, d: int, k: int) -> int:
     digit count ``d`` with ``k`` in-kernel digits: the contrib-word ORs of
     the kernel's w assembly plus every vector op inside each block's
     `compress` (final block in final_only form), threading the state's
-    vectorness across blocks exactly as the kernel does."""
+    vectorness across blocks exactly as the kernel does.
+
+    Vector words mirror the PRODUCTION (digit-position-dynamic) kernel:
+    every word of the dyn window is a vector (OR with a runtime contrib
+    tile, zero or not), not just the d-class's own digit words — this is
+    the dyn kernel's documented cost and must be in the op model or the
+    sustained-throughput estimate comes out biased low."""
+    from bitcoin_miner_tpu.ops.pallas_sha256 import dyn_params
     from bitcoin_miner_tpu.ops.sha256 import build_layout, compress
 
     layout = build_layout(data.encode(), d)
-    cwords = {p.word for p in layout.digit_pos[layout.digit_count - k :]}
+    window = dyn_params(layout, k)
+    if window is not None:
+        cwords = set(range(window[0], window[1] + 1))
+    else:  # d == k static fallback: only the digit words are vector
+        cwords = {p.word for p in layout.digit_pos[layout.digit_count - k :]}
     state = tuple(_Tr(False) for _ in range(8))  # midstate scalars
     total = 0
     for b in range(layout.n_tail_blocks):
