@@ -56,15 +56,18 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
 
-# Tail shapes for 10-digit nonces (base 1e9): 'cmu440' -> 1 vector block,
-# low-6 digits at bytes 11..16 -> contrib words {2,3,4}; 'y'*54 -> c_len 55,
-# digits at bytes 55..64, low-6 digits at bytes 59..64 -> contrib words
-# {14,15,16}: BOTH tail blocks carry vector words (a 60-byte prefix would
-# leave block 0 fully constant => scalar-unit, measuring nothing) AND both
-# shapes stream exactly THREE contrib VMEM windows per program, so the
-# per-program overhead o really is identical between the two measurements
-# (a 2-contrib-word probe like 'y'*57 would fold a small window-streaming
-# asymmetry into the marginal).
+# Tail shapes for 10-digit nonces (base 1e9).  The production kernel is
+# digit-position-DYNAMIC (ops/pallas_sha256.make_pallas_minhash_dyn): its
+# vector-word set is the whole dyn window, not just this d-class's digit
+# words, and the op model below mirrors that.  'cmu440' -> 1 vector
+# block, dyn window = words 2..6; 'y'*54 -> c_len 55, digits at bytes
+# 55..64, dyn window = words 14..18.  BOTH tail blocks carry vector words
+# (a 60-byte prefix would leave block 0 fully constant => scalar-unit,
+# measuring nothing) AND both shapes stream exactly FIVE contrib VMEM
+# windows per program, so the per-program overhead o really is identical
+# between the two measurements.  If you change a probe shape, re-check
+# the two dyn windows (pallas_sha256.dyn_params) are the same width —
+# unequal window streaming folds an asymmetry into the marginal.
 DATA_1BLK = "cmu440"
 DATA_2BLK = "y" * 54
 
