@@ -195,8 +195,14 @@ class TestPallasTier:
             dlen = rng.choice([0, 3, 54, 55, 56, 57, 58, 60, 61, 120])
             data = "f" * dlen
             d = rng.choice([2, 3])  # digit counts (k <= 2 keeps compiles fast)
-            lo = rng.randint(10 ** (d - 1), 10**d - 30)
-            hi = min(lo + rng.randint(1, 150), 10**d - 1)
+            if rng.random() < 0.3:
+                # Straddle the digit-class boundary: two classes, two
+                # kernels (dyn shares one executable per k), one min-fold.
+                lo = 10**d - rng.randint(5, 40)
+                hi = 10**d + rng.randint(5, 40)
+            else:
+                lo = rng.randint(10 ** (d - 1), 10**d - 30)
+                hi = min(lo + rng.randint(1, 150), 10**d - 1)
             r = sweep_min_hash(
                 data, lo, hi, backend="pallas", interpret=True, batch=2, max_k=2
             )
