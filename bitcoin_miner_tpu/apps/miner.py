@@ -19,6 +19,7 @@ to the scheduler — multi-chip is invisible at the protocol boundary.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import Callable, Optional, Tuple
 
@@ -107,13 +108,20 @@ class _PipelineSearch:
     dispatches of the NEXT chunk enqueue on the device while the current
     chunk computes, so back-to-back Requests cost zero device idle."""
 
-    def __init__(self, backend: Optional[str]) -> None:
+    def __init__(
+        self, backend: Optional[str], devices: Optional[int] = None
+    ) -> None:
         from concurrent.futures import Future
 
         from ..ops.sweep import SweepPipeline
 
+        mesh = None
+        if devices is not None and devices != 1:
+            from ..parallel import default_mesh
+
+            mesh = default_mesh(devices)
         self._Future = Future
-        self._p = SweepPipeline(backend=backend)
+        self._p = SweepPipeline(backend=backend, mesh=mesh)
 
     def submit(self, data: str, lower: int, upper: int):
         out = self._Future()
@@ -141,21 +149,29 @@ class _PipelineSearch:
 
 def make_async_search(backend: str = "auto", devices: Optional[int] = None):
     """Build the async (submit -> Future of (hash, nonce)) search the miner
-    serves Requests with.  JAX single-device tiers get the cross-request
-    SweepPipeline; the cpu tier and the sharded mesh search run behind a
+    serves Requests with.  JAX tiers get the cross-request SweepPipeline —
+    single-device or mesh-sharded (a multi-chip miner must not idle its
+    whole mesh between chunks); only the cpu tier runs behind a
     single-worker pool (FIFO, compute-bound anyway)."""
-    if backend == "cpu" or (devices is not None and devices != 1):
-        return _PoolSearch(make_search(backend, devices))
+    multi = devices is not None and devices != 1
+    if devices is not None and devices < 1:
+        raise ValueError(f"--devices must be >= 1, got {devices}")
+    if backend == "cpu":
+        # make_search owns the cpu+mesh rejection (single-sourced message).
+        return _PoolSearch(make_search("cpu", devices))
     if backend == "auto":
         from ..utils.platform import is_tpu
 
         if not is_tpu():
-            return _PoolSearch(make_search("cpu"))
-        backend = None  # ops layer picks pallas-on-TPU
+            if not multi:
+                return _PoolSearch(make_search("cpu"))
+            backend = "xla"  # CPU mesh (tests): sharded xla pipeline
+        else:
+            backend = None  # ops layer picks pallas-on-TPU
     from ..utils.platform import enable_compile_cache
 
     enable_compile_cache()
-    return _PipelineSearch(backend)
+    return _PipelineSearch(backend, devices=devices)
 
 
 def run_miner(client: "lsp.Client", search) -> None:
@@ -332,6 +348,14 @@ def main(argv=None) -> int:
     parser.add_argument("--num-hosts", type=int, default=None)
     parser.add_argument("--host-id", type=int, default=None)
     args = parser.parse_args(argv[1:])
+    # Hermetic CPU-mesh override for driving the --devices CLI without N
+    # real chips (env vars alone are too late here — sitecustomize boots
+    # jax with the TPU plugin; same mechanism as dryrun_multichip).
+    force_n = os.environ.get("BMT_FORCE_CPU_DEVICES")
+    if force_n:
+        from ..utils.platform import force_virtual_cpu
+
+        force_virtual_cpu(int(force_n))
     if args.multihost:
         if None in (args.coordinator, args.num_hosts, args.host_id):
             print("--multihost requires --coordinator, --num-hosts, --host-id")
@@ -345,7 +369,6 @@ def main(argv=None) -> int:
     except ValueError as e:
         print("Invalid miner configuration:", e)
         return 0
-    import os
     import time as _time
 
     if os.environ.get("BMT_MINER_LOG"):

@@ -464,23 +464,47 @@ class SweepPipeline:
         interpret: bool = False,
         max_inflight: int = 32,
         host_lane_budget: Optional[int] = None,
+        mesh=None,
+        axis_name: str = "miners",
     ) -> None:
         import queue as _queue
         import threading
         from concurrent.futures import Future
 
         self._Future = Future
+        if mesh is not None and backend is None:
+            # Resolve the backend from the MESH devices, not the process
+            # default (same guard as sweep_min_hash_sharded: a CPU mesh in
+            # a TPU-default process must get xla, not a Mosaic kernel).
+            from ..utils.platform import is_tpu_device
+
+            if not is_tpu_device(mesh.devices.flat[0]):
+                backend = "xla"
         self._backend, self._batch, self._max_k = auto_tune(backend, batch, max_k)
         self._tile = tile
         self._cpb = cpb
         self._interpret = interpret
+        # Mesh mode: the same cross-request pipeline drives the sharded
+        # (shard_map + pmin cascade) kernels — a multi-chip miner must not
+        # idle its whole mesh between the scheduler's chunks any more than
+        # a single chip may.  ``batch`` stays per-device; dispatch rows
+        # total n_devices * batch, sharded contiguously along axis_name.
+        self._mesh = mesh
+        self._axis_name = axis_name
+        self._per_dev_batch = self._batch
         # None = auto: this is the miner's production path, where a tiny
         # digit class must never cost a Mosaic compile (see HostFold).
         self._host_lane_budget = (
             auto_host_lane_budget() if host_lane_budget is None
             else host_lane_budget
         )
-        self._rolled = not is_tpu()
+        if mesh is not None:
+            from ..utils.platform import is_tpu_device
+
+            self._batch = mesh.devices.size * self._per_dev_batch
+            self._rolled = not is_tpu_device(mesh.devices.flat[0])
+        else:
+            self._rolled = not is_tpu()
         self._prewarmed: set = set()
         self._prewarm_lock = threading.Lock()
         # Single-flight warm-up per kernel class (keyed by the lru-cached
@@ -573,9 +597,7 @@ class SweepPipeline:
             with self._class_lock(kern):
                 if key in self._warm_keys:
                     return
-                out = _invoke_kernel(
-                    self._backend, kern, midstate, tail_const, bounds
-                )
+                out = self._invoke(kern, midstate, tail_const, bounds)
                 for o in out:
                     o.block_until_ready()
                 self._warm_keys.add(key)
@@ -600,6 +622,19 @@ class SweepPipeline:
             pass  # already resolved by the other thread
 
     def _get_kernel(self, layout, group):
+        if self._mesh is not None:
+            from ..parallel.sweep import sharded_kernel_for
+
+            return sharded_kernel_for(
+                layout,
+                group,
+                self._per_dev_batch,
+                self._mesh,
+                self._axis_name,
+                self._backend,
+                self._interpret,
+                self._rolled,
+            )
         return _build_kernel(
             self._backend,
             self._batch,
@@ -610,6 +645,16 @@ class SweepPipeline:
             layout,
             group,
         )
+
+    def _invoke(self, kern, midstate, tail_const, bounds):
+        if self._mesh is not None:
+            from ..parallel.sweep import sharded_invoke
+
+            return sharded_invoke(
+                kern, midstate, tail_const, bounds,
+                self._mesh, self._axis_name,
+            )
+        return _invoke_kernel(self._backend, kern, midstate, tail_const, bounds)
 
     def _class_lock(self, kern):
         import threading
@@ -636,9 +681,7 @@ class SweepPipeline:
                 # the same class.  Warm classes just enqueue (~ms) so the
                 # lock is uncontended in steady state.
                 with self._class_lock(kern):
-                    out = _invoke_kernel(
-                        self._backend, kern, midstate, tail_const, bounds
-                    )
+                    out = self._invoke(kern, midstate, tail_const, bounds)
                     self._warm_keys.add(getattr(kern, "class_key", kern))
                     return out
 
@@ -696,11 +739,17 @@ class SweepPipeline:
                     best[:] = [cand]
                 continue
             try:
-                h0, h1, flat_idx = out
-                fi = int(flat_idx)  # blocks until the dispatch lands
+                if len(out) == 4:  # mesh mode: (h0, h1, device, flat)
+                    h0, h1, dev, flat_idx = out
+                    fi = int(flat_idx)  # blocks until the dispatch lands
+                    row = int(dev) * self._per_dev_batch + fi // n_lanes
+                else:
+                    h0, h1, flat_idx = out
+                    fi = int(flat_idx)
+                    row = fi // n_lanes
                 if fi != I32_MAX:
                     h = (int(h0) << 32) | int(h1)
-                    cand = (h, bases[fi // n_lanes] + fi % n_lanes)
+                    cand = (h, bases[row] + fi % n_lanes)
                     best = state["best"]
                     if not best or cand < best[0]:
                         best[:] = [cand]

@@ -178,6 +178,74 @@ def _make_sharded_kernel_dyn(
     return jax.jit(mapped), n_pad
 
 
+def sharded_kernel_for(
+    layout,
+    group,
+    batch_per_device: int,
+    mesh: Mesh,
+    axis_name: str,
+    backend: str,
+    interpret: bool,
+    rolled: bool,
+):
+    """Build (or fetch cached) the sharded kernel closure for one digit
+    class: ``kern(midstate, tail_const, bounds) -> (g_h0, g_h1, g_dev,
+    g_flat)``.  Shared by the synchronous sharded driver below and the
+    mesh mode of ``ops.sweep.SweepPipeline``; dyn-kernel closures carry
+    ``class_key`` for the pipeline's single-flight build locks."""
+    low_pos = layout.digit_pos[layout.digit_count - group.k :]
+    if backend == "pallas":
+        from ..ops.pallas_sha256 import dyn_params
+
+        window = dyn_params(layout, group.k)
+        if window is not None:
+            w_lo, w_hi = window
+            fn, n_pad = _make_sharded_kernel_dyn(
+                layout.n_tail_blocks,
+                w_lo,
+                w_hi,
+                group.k,
+                batch_per_device,
+                mesh,
+                axis_name,
+                interpret,
+            )
+            contribs = _mesh_contribs(
+                group.k, low_pos, w_lo, w_hi, n_pad, mesh
+            )
+
+            def kern(midstate, tail_const, bounds, _fn=fn, _c=contribs):
+                return _fn(midstate, tail_const, bounds, *_c)
+
+            kern.class_key = fn
+            return kern
+        # d == k (the d=1 class): outside the dyn window domain; one
+        # class, so per-class compilation costs nothing extra.
+    return _make_sharded_kernel(
+        layout.n_tail_blocks,
+        low_pos,
+        group.k,
+        batch_per_device,
+        mesh,
+        axis_name,
+        backend,
+        interpret,
+        rolled,
+    )
+
+
+def sharded_invoke(kern, midstate, tail_const, bounds, mesh: Mesh, axis_name: str):
+    """Queue one sharded dispatch: rows sharded contiguously along
+    ``axis_name``, midstate replicated."""
+    row = NamedSharding(mesh, P(axis_name, None))
+    rep = NamedSharding(mesh, P())
+    return kern(
+        jax.device_put(midstate, rep),
+        jax.device_put(tail_const, row),
+        jax.device_put(bounds, row),
+    )
+
+
 def sweep_min_hash_sharded(
     data: str,
     lower: int,
@@ -217,43 +285,9 @@ def sweep_min_hash_sharded(
     rep_sharding = NamedSharding(mesh, P())
 
     def get_kernel(layout, group):
-        low_pos = layout.digit_pos[layout.digit_count - group.k :]
-        if backend == "pallas":
-            from ..ops.pallas_sha256 import dyn_params
-
-            window = dyn_params(layout, group.k)
-            if window is not None:
-                w_lo, w_hi = window
-                fn, n_pad = _make_sharded_kernel_dyn(
-                    layout.n_tail_blocks,
-                    w_lo,
-                    w_hi,
-                    group.k,
-                    batch_per_device,
-                    mesh,
-                    axis_name,
-                    interpret,
-                )
-                contribs = _mesh_contribs(
-                    group.k, low_pos, w_lo, w_hi, n_pad, mesh
-                )
-
-                def kern(midstate, tail_const, bounds, _fn=fn, _c=contribs):
-                    return _fn(midstate, tail_const, bounds, *_c)
-
-                return kern
-            # d == k (the d=1 class): outside the dyn window domain; one
-            # class, so per-class compilation costs nothing extra.
-        return _make_sharded_kernel(
-            layout.n_tail_blocks,
-            low_pos,
-            group.k,
-            batch_per_device,
-            mesh,
-            axis_name,
-            backend,
-            interpret,
-            rolled,
+        return sharded_kernel_for(
+            layout, group, batch_per_device, mesh, axis_name, backend,
+            interpret, rolled,
         )
 
     if stats is not None:

@@ -484,3 +484,59 @@ def test_server_logs_health(caplog):
         assert "jobs_completed" in caplog.text
     finally:
         server.close()
+
+
+@pytest.mark.slow
+def test_mesh_miner_cli_subprocess_fleet():
+    """The --devices CLI path as real subprocesses: server + an 8-virtual-
+    CPU-device mesh miner (BMT_FORCE_CPU_DEVICES — env vars alone can't
+    override the boot platform here) + client, oracle-exact Result.
+    Covers the pipelined sharded search behind the actual binary."""
+    import os
+    import subprocess
+    import sys
+    import time
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    port = 3000 + (os.getpid() * 6151) % 50000
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(repo) + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    server = subprocess.Popen(
+        [sys.executable, "-m", "bitcoin_miner_tpu.apps.server", str(port)],
+        cwd=str(repo), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    miner = None
+    try:
+        import select
+
+        deadline = time.monotonic() + 30
+        up = False
+        while not up:
+            assert time.monotonic() < deadline, "server did not come up"
+            assert server.poll() is None, "server died at startup"
+            ready, _, _ = select.select([server.stdout], [], [], 1.0)
+            if ready:
+                up = "listening" in (server.stdout.readline() or "")
+        miner = subprocess.Popen(
+            [sys.executable, "-m", "bitcoin_miner_tpu.apps.miner",
+             f"127.0.0.1:{port}", "--devices", "8"],
+            cwd=str(repo),
+            env={**env, "BMT_FORCE_CPU_DEVICES": "8", "JAX_PLATFORMS": "cpu"},
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        out = subprocess.run(
+            [sys.executable, "-m", "bitcoin_miner_tpu.apps.client",
+             f"127.0.0.1:{port}", "meshcli", "300000"],
+            cwd=str(repo), env=env, capture_output=True, text=True,
+            timeout=180,
+        )
+        h, n = min_hash_range("meshcli", 0, 300000)
+        assert out.stdout.strip() == f"Result {h} {n}", out.stdout
+    finally:
+        for p in (miner, server):
+            if p is not None and p.poll() is None:
+                p.kill()

@@ -84,3 +84,41 @@ def test_sharded_matches_single_device_tier():
     )
     r1 = sweep_min_hash(data, lo, hi, backend="xla", max_k=2)
     assert (rs.hash, rs.nonce) == (r1.hash, r1.nonce)
+
+
+def test_mesh_pipeline_matches_oracle():
+    # The cross-request SweepPipeline in mesh mode: back-to-back sharded
+    # jobs over the 8-device mesh, each bit-exact vs the oracle — the
+    # multi-chip miner's production search path (apps/miner.py
+    # make_async_search with --devices N).
+    from bitcoin_miner_tpu.ops.sweep import SweepPipeline
+
+    p = SweepPipeline(
+        backend="xla", mesh=default_mesh(8), max_k=2, batch=2,
+        host_lane_budget=0,
+    )
+    try:
+        futs = [
+            p.submit("cmu440", 1000, 2234),
+            p.submit("cmu440", 2235, 3499),
+            p.submit("x", 95, 305),  # different data + digit boundary
+        ]
+        wants = [("cmu440", 1000, 2234), ("cmu440", 2235, 3499), ("x", 95, 305)]
+        for f, (d, lo, hi) in zip(futs, wants):
+            r = f.result(timeout=300)
+            assert (r.hash, r.nonce) == min_hash_range(d, lo, hi), (d, lo, hi)
+            assert r.lanes_swept == hi - lo + 1
+    finally:
+        p.close()
+
+
+def test_make_async_search_routes_mesh_to_pipeline():
+    from bitcoin_miner_tpu.apps.miner import _PipelineSearch, make_async_search
+
+    s = make_async_search("auto", devices=8)
+    try:
+        assert isinstance(s, _PipelineSearch)
+        h, n = s.submit("cmu440", 1000, 1999).result(timeout=300)
+        assert (h, n) == min_hash_range("cmu440", 1000, 1999)
+    finally:
+        s.close()
