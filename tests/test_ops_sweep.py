@@ -386,3 +386,57 @@ class TestDynKernel:
             tiles = window_contribs_np(6, low_pos, w_lo, w_hi, 4096)
             zeros |= {id(t) for t in tiles if t is zero_tile_np(4096)}
         assert len(zeros) == 1, "untouched words must share ONE zero tile"
+
+
+class TestPipelineLifecycle:
+    """SweepPipeline edge behavior: close/submit ordering and concurrent
+    submitters — the states a miner hits at shutdown and under the
+    scheduler's 2-deep window."""
+
+    def test_submit_after_close_raises(self):
+        from bitcoin_miner_tpu.ops.sweep import SweepPipeline
+
+        p = SweepPipeline(backend="xla", max_k=2, batch=2)
+        p.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            p.submit("cmu440", 0, 10)
+
+    def test_jobs_submitted_before_close_still_resolve(self):
+        from bitcoin_miner_tpu.ops.sweep import SweepPipeline
+
+        p = SweepPipeline(backend="xla", max_k=2, batch=2, host_lane_budget=0)
+        futs = [p.submit("cmu440", 1000 + 100 * i, 1099 + 100 * i)
+                for i in range(3)]
+        p.close()  # close() drains queued jobs, it does not abandon them
+        for i, f in enumerate(futs):
+            lo, hi = 1000 + 100 * i, 1099 + 100 * i
+            r = f.result(timeout=300)
+            assert (r.hash, r.nonce) == min_hash_range("cmu440", lo, hi)
+
+    def test_concurrent_submitters_all_correct(self):
+        import threading
+
+        from bitcoin_miner_tpu.ops.sweep import SweepPipeline
+
+        p = SweepPipeline(backend="xla", max_k=2, batch=2, host_lane_budget=0)
+        results = {}
+        lock = threading.Lock()
+
+        def worker(i):
+            lo, hi = 2000 + 137 * i, 2000 + 137 * i + 99
+            r = p.submit("cmu440", lo, hi).result(timeout=300)
+            with lock:
+                results[i] = ((r.hash, r.nonce), min_hash_range("cmu440", lo, hi))
+
+        try:
+            ts = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=300)
+                assert not t.is_alive()
+        finally:
+            p.close()
+        assert len(results) == 6
+        for got, want in results.values():
+            assert got == want
