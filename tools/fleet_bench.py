@@ -8,15 +8,24 @@ LSP client — runs a big job, and reports **delivered nonces/s** next to the
 kernel rate, so scheduler/transport overhead is a measured number instead
 of a guess.
 
-Two jobs run:
+Jobs run in order:
 
 - a **warm-up job** (default 4e9 nonces) that pays the one-time costs —
-  TPU runtime init, Mosaic compiles of the ramp's small shape classes
-  (persistent-cached across runs), and the scheduler's EWMA rate ramp from
-  `min_chunk` to full-size chunks;
+  TPU runtime init, the dynamic kernel's one build (persistent-cached
+  across runs), and the scheduler's EWMA rate ramp from `min_chunk` to
+  full-size chunks;
+- **class warm-ups**: one tiny job per digit class the timed job touches
+  beyond the warm-up range (same contract as bench.py: compiles precede
+  the measurement window);
 - the **timed job** (default 2e10 nonces), whose delivered rate is the
   steady-state fleet number the JSON line reports.  The warm-up wall time
-  is reported alongside so cold-start cost stays visible.
+  is reported alongside so cold-start cost stays visible;
+- optionally the **kill drill** (`--kill-drill`): the same fresh-range job
+  clean and with a mid-job miner SIGKILL+respawn, asserting identical
+  `(hash, nonce)` — the scheduler's reassignment invariant on hardware.
+
+`--cpu-miners N` adds N native C++ workers to the fleet (heterogeneous
+scheduling under one scheduler; liveness-checked).
 
 Fault tolerance IS the harness (same lesson as bench.py round 1): the
 tunnelled TPU runtime sometimes wedges a fresh process at init, and a
