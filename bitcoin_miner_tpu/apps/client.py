@@ -13,6 +13,7 @@ from typing import Optional, TextIO, Tuple
 
 from .. import lsp
 from ..bitcoin.message import Message, MsgType
+from ..utils.metrics import METRICS
 
 
 def request_once(
@@ -30,8 +31,77 @@ def request_once(
             return msg.hash, msg.nonce
 
 
+def request_with_retry(
+    host: str,
+    port: int,
+    message: str,
+    max_nonce: int,
+    *,
+    retries: int = 3,
+    backoff_base: float = 0.25,
+    backoff_cap: float = 4.0,
+    params: Optional["lsp.Params"] = None,
+    label: Optional[str] = None,
+    first_client: Optional["lsp.Client"] = None,
+    sleep=None,
+) -> Optional[Tuple[int, int]]:
+    """Bounded retry-with-resubmit: one initial attempt plus up to
+    ``retries`` resubmissions.  On a lost connection, reconnect (with
+    exponential backoff) and resubmit the *identical* ``(data, 0, max_nonce)``
+    Request.  Because that triple is the scheduler's checkpoint identity,
+    a server that stashed the orphaned job's progress (Scheduler.lost) or
+    restarted from a checkpoint resumes the sweep instead of restarting it.
+    ``first_client`` supplies an already-connected conn for the initial
+    attempt (the CLI's, so its connect-failure reporting stays in main).
+    Returns None once every attempt has failed."""
+    import time as _time
+
+    from ..utils.retry import backoff_delay
+
+    sleep = _time.sleep if sleep is None else sleep
+    for attempt in range(retries + 1):
+        if attempt:
+            sleep(backoff_delay(attempt, backoff_base, backoff_cap))
+        if attempt == 0 and first_client is not None:
+            client = first_client
+        else:
+            try:
+                client = lsp.Client(host, port, params, label=label)
+            except (lsp.LspError, OSError):
+                continue  # server unreachable this attempt: back off, retry
+        if attempt:
+            # Counted only once a Request will actually be resubmitted —
+            # failed reconnect attempts are not resubmissions.
+            METRICS.inc("client.resubmits")
+        try:
+            result = request_once(client, message, max_nonce)
+        finally:
+            try:
+                client.close()
+            except lsp.LspError:
+                pass
+        if result is not None:
+            return result
+    return None
+
+
 def main(argv=None, out: TextIO = sys.stdout) -> int:
     argv = sys.argv if argv is None else argv
+    # Beyond-parity flag (same idiom as the server's --checkpoint=FILE):
+    # --retries=N resubmits after a lost conn instead of printing
+    # Disconnected.  Default 0 preserves the frozen stdout contract.
+    retries = 0
+    pos = [argv[0]]
+    for a in argv[1:]:
+        if a.startswith("--retries="):
+            try:
+                retries = max(0, int(a.split("=", 1)[1]))
+            except ValueError:
+                print(f"{a} is not a number.", file=out)
+                return 0
+        else:
+            pos.append(a)
+    argv = pos
     if len(argv) != 4:
         print(f"Usage: ./{argv[0]} <hostport> <message> <maxNonce>", end="", file=out)
         return 0
@@ -49,14 +119,22 @@ def main(argv=None, out: TextIO = sys.stdout) -> int:
     except (lsp.LspError, OSError, ValueError) as e:
         print("Failed to connect to server:", e, file=out)
         return 0
-    try:
-        result = request_once(client, message, max_nonce)
-        if result is None:
-            print("Disconnected", file=out)  # client.go:46-48
-        else:
-            print("Result", result[0], result[1], file=out)  # client.go:41-43
-    finally:
-        client.close()
+    if retries > 0:
+        # The initial attempt rides the conn we just opened; each of the N
+        # resubmissions is counted (client.resubmits) and backed off.
+        result = request_with_retry(
+            host or "127.0.0.1", int(port), message, max_nonce,
+            retries=retries, first_client=client,
+        )
+    else:
+        try:
+            result = request_once(client, message, max_nonce)
+        finally:
+            client.close()
+    if result is None:
+        print("Disconnected", file=out)  # client.go:46-48
+    else:
+        print("Result", result[0], result[1], file=out)  # client.go:41-43
     return 0
 
 

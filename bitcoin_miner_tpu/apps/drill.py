@@ -1,0 +1,181 @@
+"""In-process chaos drill: one seeded fleet run under hostile network weather.
+
+The substrate the chaos soak suite (tests/test_chaos_soak.py) and the
+command-line replayer (tools/chaos_replay.py) share: assemble a real
+client/server/miner fleet over loopback UDP, arm a seeded
+network-condition :class:`~bitcoin_miner_tpu.lspnet.chaos.Schedule`
+(optionally killing a miner mid-job), and check the final Result bit-exact
+against the hashlib oracle.  Every random fault decision flows from the
+drill's seed, so a failing run is replayable from its
+``(scenario, seed)`` pair alone.
+
+Fleet shape: the server is labeled ``server``, miners ``miner-0..N-1``,
+the client ``client-0`` — the names the standard scenarios target.
+``miner-0`` runs the plain exit-on-loss lifetime (it is the kill target);
+the rest run :func:`~bitcoin_miner_tpu.apps.miner.run_miner_resilient` and
+re-Join through partitions.  The client uses bounded retry-with-resubmit,
+so a mid-job client conn loss resumes via the scheduler's orphan stash.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple, Union
+
+from .. import lsp, lspnet
+from ..bitcoin.hash import min_hash_range
+from ..lspnet.chaos import CHAOS, Schedule, standard_scenarios
+from ..utils.metrics import METRICS
+from . import client as client_mod
+from . import miner as miner_mod
+from . import server as server_mod
+from .scheduler import Scheduler
+
+#: Counter prefixes whose deltas a drill reports.
+_REPORT_PREFIXES = ("chaos.", "miner.", "client.", "sched.")
+
+
+@dataclass
+class DrillReport:
+    ok: bool
+    expected: Optional[Tuple[int, int]]
+    got: Optional[Tuple[int, int]]
+    seed: int
+    scenario: str
+    elapsed: float
+    #: METRICS deltas over the drill (chaos./miner./client./sched. keys).
+    counters: Dict[str, int] = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "expected": list(self.expected) if self.expected else None,
+            "got": list(self.got) if self.got else None,
+            "seed": self.seed,
+            "scenario": self.scenario,
+            "elapsed_s": round(self.elapsed, 3),
+            "counters": self.counters,
+        }
+
+
+def run_drill(
+    scenario: Union[Schedule, str, None] = None,
+    *,
+    seed: int = 1,
+    data: str = "chaos",
+    max_nonce: int = 4000,
+    n_miners: int = 2,
+    kill_miner_at: Optional[float] = None,
+    epoch_millis: int = 100,
+    epoch_limit: int = 5,
+    window: int = 5,
+    min_chunk: int = 400,
+    straggler_min_seconds: float = 4.0,
+    retries: int = 6,
+    timeout: float = 120.0,
+) -> DrillReport:
+    """Run one seeded fleet-under-chaos drill; see module docstring."""
+    params = lsp.Params(epoch_limit, epoch_millis, window)
+    name = scenario if isinstance(scenario, str) else (
+        getattr(scenario, "desc", "") or "custom" if scenario else "clean"
+    )
+    if isinstance(scenario, str):
+        library = standard_scenarios(params.epoch_seconds)
+        if scenario not in library:
+            raise ValueError(
+                f"unknown scenario {scenario!r}; valid: {sorted(library)}"
+            )
+        scenario = library[scenario]
+
+    lspnet.reset_faults()
+    CHAOS.reset()
+    CHAOS.seed(seed)
+    before = METRICS.snapshot()
+    t0 = time.monotonic()
+    kill_timer: Optional[threading.Timer] = None
+    stop_miners = threading.Event()  # ends resilient loops at teardown
+
+    server = lsp.Server(0, params, label="server")
+    sched = Scheduler(
+        min_chunk=min_chunk, straggler_min_seconds=straggler_min_seconds
+    )
+    threading.Thread(
+        target=server_mod.serve,
+        args=(server, sched),
+        kwargs={"tick_interval": 0.2},
+        daemon=True,
+    ).start()
+    try:
+        # miner-0: plain exit-on-loss lifetime — the kill target we hold a
+        # conn handle for; the rest: resilient reconnect-with-backoff.
+        victim = lsp.Client("127.0.0.1", server.port, params, label="miner-0")
+        threading.Thread(
+            target=miner_mod.run_miner,
+            args=(victim, miner_mod.make_search("cpu")),
+            daemon=True,
+        ).start()
+        for i in range(1, n_miners):
+            threading.Thread(
+                target=miner_mod.run_miner_resilient,
+                args=("127.0.0.1", server.port, miner_mod.make_search("cpu")),
+                kwargs={
+                    "params": params,
+                    "max_retries": 12,
+                    "backoff_base": 0.1,
+                    "backoff_cap": 1.0,
+                    "label": f"miner-{i}",
+                    "stop": stop_miners,
+                },
+                daemon=True,
+            ).start()
+        if kill_miner_at is not None:
+            kill_timer = threading.Timer(kill_miner_at, victim.close)
+            kill_timer.daemon = True
+            kill_timer.start()
+        if scenario is not None:
+            CHAOS.run(scenario)
+
+        got_box: list = [None]
+
+        def run_client() -> None:
+            got_box[0] = client_mod.request_with_retry(
+                "127.0.0.1",
+                server.port,
+                data,
+                max_nonce,
+                retries=retries,
+                backoff_base=0.2,
+                params=params,
+                label="client-0",
+            )
+
+        ct = threading.Thread(target=run_client, daemon=True)
+        ct.start()
+        ct.join(timeout=timeout)
+        got = None if ct.is_alive() else got_box[0]
+    finally:
+        if kill_timer is not None:
+            kill_timer.cancel()
+        stop_miners.set()  # before server.close(): no post-drill redialing
+        CHAOS.reset()
+        lspnet.reset_faults()
+        server.close()
+
+    expected = min_hash_range(data, 0, max_nonce)
+    after = METRICS.snapshot()
+    deltas = {
+        k: after[k] - before.get(k, 0)
+        for k in sorted(after)
+        if k.startswith(_REPORT_PREFIXES) and after[k] != before.get(k, 0)
+    }
+    return DrillReport(
+        ok=got == expected,
+        expected=expected,
+        got=got,
+        seed=seed,
+        scenario=name,
+        elapsed=time.monotonic() - t0,
+        counters=deltas,
+    )

@@ -174,9 +174,15 @@ def make_async_search(backend: str = "auto", devices: Optional[int] = None):
     return _PipelineSearch(backend, devices=devices)
 
 
-def run_miner(client: "lsp.Client", search) -> None:
+def run_miner(client: "lsp.Client", search, close_search: bool = True) -> bool:
     """Join and serve Requests until the server connection dies (the
     reference miner's intended lifetime: exit on server loss).
+    ``close_search=False`` keeps an externally-owned async search alive
+    across calls — the reconnect loop (:func:`run_miner_resilient`) reuses
+    one search (and its warm compiles) over many connections.
+    Returns True if the exit was a (reconnect-worthy) connection loss,
+    False if the search backend itself failed — a broken backend must stop
+    the miner, not send it into a join/fail/reconnect churn.
 
     ``search`` is either a plain ``(data, lo, hi) -> (hash, nonce)``
     callable (wrapped in a one-worker pool) or an async object with
@@ -197,6 +203,7 @@ def run_miner(client: "lsp.Client", search) -> None:
     asearch = _PoolSearch(search) if owned else search
     client.write(Message.join().marshal())
     inflight: "_queue.Queue" = _queue.Queue()
+    _SEARCH_FAILED = object()  # dispatch-time backend failure sentinel
 
     def reader() -> None:
         while True:
@@ -215,10 +222,12 @@ def run_miner(client: "lsp.Client", search) -> None:
                 prewarm = getattr(asearch, "prewarm", None)
                 if prewarm is not None:
                     prewarm(msg.data, msg.upper)
-            except Exception:
-                # Search closed under us (main loop exiting): a Request
-                # racing the shutdown must not traceback this thread.
-                inflight.put(None)
+            except Exception as e:
+                # Dispatch-time backend failure (or the search closing
+                # under a shutdown race): surface it as a SEARCH failure,
+                # not a conn loss — the resilient loop must not reconnect-
+                # churn a live server over a broken backend.
+                inflight.put((_SEARCH_FAILED, e))
                 return
 
     t = threading.Thread(target=reader, name="miner-reader", daemon=True)
@@ -227,8 +236,11 @@ def run_miner(client: "lsp.Client", search) -> None:
         while True:
             item = inflight.get()
             if item is None:
-                return
+                return True
             fut, msg = item
+            if fut is _SEARCH_FAILED:
+                print(f"miner: search failed: {msg!r}", file=sys.stderr)
+                return False
             try:
                 h, n = fut.result()
             except Exception as e:
@@ -236,16 +248,272 @@ def run_miner(client: "lsp.Client", search) -> None:
                 # a traceback mid-protocol; exit cleanly so the server
                 # reassigns.
                 print(f"miner: search failed: {e!r}", file=sys.stderr)
-                return
+                return False
             METRICS.inc("miner.nonces", msg.upper - msg.lower + 1)
             try:
                 client.write(Message.result(h, n).marshal())
             except lsp.LspError:
-                return
+                return True
     finally:
         # Don't block on an in-flight sweep (it may be wedged — that's why
         # we're exiting); daemon threads are reaped with the process.
+        if owned or close_search:
+            asearch.close()
+
+
+def run_miner_resilient(
+    host: str,
+    port: int,
+    search,
+    params: Optional["lsp.Params"] = None,
+    *,
+    max_retries: int = 5,
+    backoff_base: float = 0.25,
+    backoff_cap: float = 8.0,
+    label: Optional[str] = None,
+    first_client: Optional["lsp.Client"] = None,
+    stop: Optional["threading.Event"] = None,
+    sleep=None,
+) -> None:
+    """Self-healing miner lifetime: Join/serve until the server connection
+    dies, then reconnect with exponential backoff and re-Join on a fresh
+    conn, abandoning any stale in-flight chunk (the scheduler's dead-miner
+    reassignment already re-queued it server-side; our late Result would be
+    FIFO-mismatched on a new conn anyway, so it is simply never written).
+
+    ``max_retries`` bounds *consecutive* failed connect attempts — any
+    successful reconnect resets the budget, so a miner rides out repeated
+    transient partitions but still exits once the server is gone for good.
+    ``stop`` (an Event) ends the lifetime at the next reconnect decision —
+    harnesses use it so torn-down fleets don't leave reconnect loops
+    dialing a dead port.  One async ``search`` (and its warm kernel
+    compiles) is reused across connections; plain callables are wrapped
+    once.
+    """
+    import time as _time
+
+    from ..utils.retry import backoff_delay
+
+    sleep = _time.sleep if sleep is None else sleep
+    asearch = _PoolSearch(search) if not hasattr(search, "submit") else search
+    client = first_client
+    connected_before = client is not None
+    failures = 0
+
+    def pause(delay: float) -> bool:
+        """Back off; True if a stop was requested meanwhile."""
+        if stop is not None:
+            return stop.wait(delay)
+        sleep(delay)
+        return False
+
+    try:
+        while not (stop is not None and stop.is_set()):
+            if client is None:
+                try:
+                    client = lsp.Client(host, port, params, label=label)
+                except (lsp.LspError, OSError):
+                    failures += 1
+                    if failures > max_retries:
+                        print(
+                            f"miner: giving up after {max_retries} reconnect "
+                            "attempts", file=sys.stderr,
+                        )
+                        return
+                    if pause(backoff_delay(failures, backoff_base, backoff_cap)):
+                        return
+                    continue
+                failures = 0
+                if connected_before:
+                    METRICS.inc("miner.reconnects")
+            connected_before = True
+            conn_lost = False
+            try:
+                conn_lost = run_miner(client, asearch, close_search=False)
+            finally:
+                try:
+                    client.close()
+                except lsp.LspError:
+                    pass
+                client = None
+            if not conn_lost:
+                # The search backend failed, not the network: reconnecting
+                # would just churn join/fail forever against a live server.
+                return
+            # Conn lost (or server closed us): retry after a beat — a dead
+            # server fails the next connect and enters the backoff ladder.
+            failures += 1
+            if failures > max_retries:
+                return
+            if pause(backoff_delay(failures, backoff_base, backoff_cap)):
+                return
+    finally:
         asearch.close()
+
+
+class _TieredSearch:
+    """Watchdog-guarded fallback chain over kernel tiers.
+
+    A wedged accelerator runtime (the failure the scheduler's straggler
+    tick sees from the *outside*) hangs the miner's search future forever;
+    this wrapper notices from the *inside* — any chunk exceeding the
+    tier's wedge budget, or raising — abandons that tier and re-runs the
+    chunk on the next one (Pallas → XLA → cpu/hashlib), so the miner
+    degrades instead of stalling.  The budget escalates ``wedge_growth``×
+    per downgrade: a chunk sized for a TPU tier honestly takes orders of
+    magnitude longer on the fallback, and a flat budget would misread
+    slow-but-healthy as wedged and cascade straight off the bottom of the
+    chain.  Chunks are served FIFO by one dispatcher thread (which
+    serializes tiers' sweeps — the price of wedge detection; production
+    TPU fleets that want pipelining run without ``--watchdog``).
+    """
+
+    _SHUTDOWN = object()
+
+    def __init__(
+        self, tiers, wedge_seconds: float = 30.0, wedge_growth: float = 8.0
+    ) -> None:
+        import queue as _queue
+        import threading
+
+        from concurrent.futures import Future
+
+        self._Future = Future
+        self._chain = list(tiers)  # [(name, factory_returning_search)]
+        self._idx = 0
+        self._active = None
+        self._active_name: Optional[str] = None
+        self._wedge = wedge_seconds
+        self._growth = wedge_growth
+        self._downgrades = 0  # real downgrades only — build-time skips of
+        # unavailable tiers must not inflate the first working tier's budget
+        self._closing = False
+        self._jobs: "_queue.Queue" = _queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, name="tiered-search", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, data: str, lower: int, upper: int):
+        out = self._Future()
+        self._jobs.put((data, lower, upper, out))
+        return out
+
+    def close(self) -> None:
+        # Flag first: the dispatcher must see closing before the active
+        # tier's futures start failing, or it would "downgrade" to a fresh
+        # tier it then never closes.
+        self._closing = True
+        self._jobs.put(self._SHUTDOWN)
+        if self._active is not None:
+            try:
+                self._active.close()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------- internals
+
+    @property
+    def active_tier(self) -> Optional[str]:
+        return self._active_name
+
+    def _tier(self):
+        while self._active is None and self._idx < len(self._chain):
+            name, factory = self._chain[self._idx]
+            try:
+                built = factory()
+                if not hasattr(built, "submit"):
+                    built = _PoolSearch(built)
+                self._active, self._active_name = built, name
+            except Exception as e:
+                print(
+                    f"miner: tier {name!r} unavailable ({e!r}); skipping",
+                    file=sys.stderr,
+                )
+                self._idx += 1
+        return self._active
+
+    def _downgrade(self, why: str) -> None:
+        import threading
+
+        METRICS.inc("miner.tier_downgrades")
+        self._downgrades += 1
+        print(
+            f"miner: tier {self._active_name!r} {why}; downgrading",
+            file=sys.stderr,
+        )
+        dead = self._active
+        self._active, self._active_name = None, None
+        self._idx += 1
+        if dead is not None:
+            # close() may block on the wedged runtime — do it off to the side.
+            threading.Thread(
+                target=lambda: _swallow(dead.close), daemon=True
+            ).start()
+
+    def _loop(self) -> None:
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        while True:
+            item = self._jobs.get()
+            if item is self._SHUTDOWN:
+                return
+            data, lo, hi, out = item
+            while True:
+                if self._closing:
+                    out.set_exception(RuntimeError("search closed"))
+                    break
+                tier = self._tier()
+                if tier is None:
+                    out.set_exception(
+                        RuntimeError("all search tiers wedged or failed")
+                    )
+                    break
+                budget = self._wedge * (self._growth ** self._downgrades)
+                try:
+                    res = tier.submit(data, lo, hi).result(timeout=budget)
+                    out.set_result(res)
+                    break
+                except _FutTimeout:
+                    if self._closing:
+                        out.set_exception(RuntimeError("search closed"))
+                        break
+                    self._downgrade(f"wedged (> {budget:g}s/chunk)")
+                except Exception as e:
+                    if self._closing:
+                        out.set_exception(RuntimeError("search closed"))
+                        break
+                    self._downgrade(f"failed ({e!r})")
+
+
+def _swallow(fn) -> None:
+    try:
+        fn()
+    except Exception:
+        pass
+
+
+def make_tiered_search(
+    backend: str = "auto",
+    devices: Optional[int] = None,
+    wedge_seconds: float = 30.0,
+) -> _TieredSearch:
+    """The self-healing search: the requested tier first, every strictly
+    weaker tier behind it, hashlib last (pure Python cannot wedge)."""
+    from ..bitcoin.hash import min_hash_range as _oracle
+
+    if backend == "auto":
+        from ..utils.platform import is_tpu
+
+        backend = "pallas" if is_tpu() else "cpu"
+    chain = []
+    if backend == "pallas":
+        chain.append(("pallas", lambda: make_async_search("pallas", devices)))
+    if backend in ("pallas", "xla"):
+        chain.append(("xla", lambda: make_async_search("xla", devices)))
+    chain.append(("cpu", lambda: _PoolSearch(make_search("cpu"))))
+    chain.append(("hashlib", lambda: _PoolSearch(_oracle)))
+    return _TieredSearch(chain, wedge_seconds=wedge_seconds)
 
 
 def serve_multihost(client, sweep: SearchFn, broadcast) -> None:
@@ -343,6 +611,13 @@ def main(argv=None) -> int:
         "--backend", choices=["auto", "pallas", "xla", "cpu"], default="auto"
     )
     parser.add_argument("--devices", type=int, default=None)
+    # Self-healing knobs: --reconnect N bounds consecutive failed re-Join
+    # attempts after a lost server conn (0 restores the reference's
+    # exit-on-loss lifetime); --watchdog SECONDS wraps the search in the
+    # kernel-tier fallback chain (pallas→xla→cpu→hashlib) with a per-chunk
+    # wedge timeout.
+    parser.add_argument("--reconnect", type=int, default=5)
+    parser.add_argument("--watchdog", type=float, default=None)
     parser.add_argument("--multihost", action="store_true")
     parser.add_argument("--coordinator", default=None)
     parser.add_argument("--num-hosts", type=int, default=None)
@@ -365,7 +640,12 @@ def main(argv=None) -> int:
         )
         return 0
     try:
-        search = make_async_search(args.backend, args.devices)
+        if args.watchdog is not None:
+            search = make_tiered_search(
+                args.backend, args.devices, wedge_seconds=args.watchdog
+            )
+        else:
+            search = make_async_search(args.backend, args.devices)
     except ValueError as e:
         print("Invalid miner configuration:", e)
         return 0
@@ -410,9 +690,18 @@ def main(argv=None) -> int:
 
     t0 = time.monotonic()
     try:
-        run_miner(client, search)
+        if args.reconnect > 0:
+            run_miner_resilient(
+                host or "127.0.0.1", int(port), search,
+                max_retries=args.reconnect, first_client=client,
+            )
+        else:
+            run_miner(client, search)
     finally:
-        client.close()
+        try:
+            client.close()
+        except lsp.LspError:
+            pass
         swept = METRICS.get("miner.nonces")
         dt = max(time.monotonic() - t0, 1e-9)
         print(

@@ -45,7 +45,10 @@ Design notes (deliberately not a translation of anything):
   snapshots each job's remaining intervals + best-so-far keyed by the job
   signature ``(data, lower, upper)``; a restarted scheduler given that
   state resumes a resubmitted identical Request without re-sweeping
-  finished sub-ranges.
+  finished sub-ranges.  A dead *client's* progress is stashed under the
+  same identity (``lost()``), so a reconnecting client that resubmits the
+  identical Request resumes mid-sweep — the server half of the client's
+  retry-with-resubmit self-healing.
 - **Lowest-nonce tie-break** on equal min-hashes, matching the kernels
   (BASELINE.md).
 - **Fairness**: round-robin across jobs with pending work.
@@ -154,6 +157,7 @@ class Scheduler:
         straggler_min_seconds: float = 10.0,
         pipeline_depth: int = 2,
         ramp_factor: int = 8,
+        orphan_cache_max: int = 256,
         resume_state: Optional[dict] = None,
     ) -> None:
         if pipeline_depth < 1:
@@ -168,6 +172,7 @@ class Scheduler:
         self.straggler_min_seconds = straggler_min_seconds
         self.pipeline_depth = pipeline_depth
         self.ramp_factor = ramp_factor
+        self.orphan_cache_max = orphan_cache_max
         self.miners: Dict[int, _Miner] = {}
         self.jobs: Dict[int, _Job] = {}
         self._job_rr: Deque[int] = deque()  # round-robin order of job ids
@@ -302,6 +307,30 @@ class Scheduler:
                 self._job_rr.remove(conn_id)
             # Outstanding miners keep crunching; their Results will find no
             # job and simply idle them (see result()).
+            # Stash the job's progress under its (data, lower, upper)
+            # identity: a client that reconnects and resubmits the identical
+            # Request (apps/client.py retry-with-resubmit) RESUMES the sweep
+            # instead of restarting it — same machinery as checkpoint
+            # restore, so the progress also persists across server restarts.
+            # Timing caveat: if the resubmission beats this loss event (the
+            # client's epoch timer can fire before ours), the new job starts
+            # full-range and the stash waits for a later twin — correct but
+            # duplicated work.  The live-twin fold below at least carries
+            # the orphan's best-so-far across that race.
+            remaining = list(job.pending) + [
+                iv for lst in job.outstanding.values() for iv in lst
+            ]
+            if job.best is not None:
+                for twin in self.jobs.values():
+                    if twin.key == job.key:
+                        twin.fold(*job.best)
+            if remaining or job.best is not None:
+                _merge_progress(self._resume, job.key, job.best, remaining)
+                METRICS.inc("sched.jobs_orphaned")
+                while len(self._resume) > self.orphan_cache_max:
+                    # Bounded memory: evict oldest-stashed first (dict
+                    # preserves insertion order; a merge re-uses its slot).
+                    self._resume.pop(next(iter(self._resume)))
         return []
 
     def tick(self, now: float) -> List[Action]:

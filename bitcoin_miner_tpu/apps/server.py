@@ -38,11 +38,17 @@ def save_checkpoint(path: str, state: dict) -> None:
 
 
 def load_checkpoint(path: str) -> Optional[dict]:
+    """None (a fresh start) on any unreadable state: missing file, torn or
+    truncated JSON, undecodable bytes, permission errors.  save_checkpoint's
+    temp-write + os.replace guarantees the file is never *partially* new —
+    a crash between the two leaves the previous complete snapshot."""
     try:
         with open(path) as f:
-            return json.load(f)
-    except (FileNotFoundError, json.JSONDecodeError):
+            state = json.load(f)
+    # ValueError covers JSONDecodeError and UnicodeDecodeError both.
+    except (OSError, ValueError):
         return None
+    return state if isinstance(state, dict) else None
 
 
 def serve(
@@ -84,9 +90,21 @@ def serve(
                 "miners_evicted",
                 "jobs_completed",
                 "jobs_resumed",
+                "jobs_orphaned",
             )
         }
-        return f"health {sched.stats()} {counters}"
+        # Chaos + self-healing counters (packets dropped/reordered/…, miner
+        # reconnects, tier downgrades, client resubmits) ride the same line
+        # so a soak's fault trace is visible in log.txt without a debugger.
+        # Only non-zero ones print — a healthy fleet's line stays short.
+        chaos = {
+            k: v
+            for k, v in sorted(METRICS.snapshot().items())
+            if v and k.startswith(("chaos.", "miner.reconnects",
+                                   "miner.tier_downgrades", "client.resubmits"))
+        }
+        line = f"health {sched.stats()} {counters}"
+        return f"{line} chaos {chaos}" if chaos else line
 
     def emit(actions) -> None:
         for conn_id, msg in actions:

@@ -97,11 +97,14 @@ class Client:
     CannotEstablishConnectionError after EpochLimit silent epochs.
     """
 
-    def __init__(self, host: str, port: int, params: Optional[Params] = None) -> None:
+    def __init__(
+        self, host: str, port: int, params: Optional[Params] = None,
+        label: Optional[str] = None,
+    ) -> None:
         self._lt = _LoopThread(f"lsp-client-{host}:{port}")
         try:
             self._c: AsyncClient = self._lt.run(
-                AsyncClient.connect(host, port, params)
+                AsyncClient.connect(host, port, params, label=label)
             )
         except BaseException:
             self._lt.stop()
@@ -132,11 +135,14 @@ class Server:
     """Blocking LSP server (API parity: lsp/server_api.go:6-39)."""
 
     def __init__(
-        self, port: int, params: Optional[Params] = None, host: str = "127.0.0.1"
+        self, port: int, params: Optional[Params] = None, host: str = "127.0.0.1",
+        label: Optional[str] = None,
     ) -> None:
         self._lt = _LoopThread(f"lsp-server-:{port}")
         try:
-            self._s: AsyncServer = self._lt.run(AsyncServer.create(port, params, host))
+            self._s: AsyncServer = self._lt.run(
+                AsyncServer.create(port, params, host, label=label)
+            )
         except BaseException:
             self._lt.stop()
             raise

@@ -5,6 +5,17 @@ dial packet loss / corruption on a real loopback network (reference
 lspnet/net.go:3-8).
 """
 
+from .chaos import (
+    CHAOS,
+    GEParams,
+    LinkConditions,
+    NetSim,
+    Schedule,
+    conditions,
+    heal,
+    partition,
+    standard_scenarios,
+)
 from .faults import (
     FAULTS,
     enable_debug_logs,
@@ -21,7 +32,16 @@ from .faults import (
 from .udp import UDPEndpoint, create_client_endpoint, create_server_endpoint
 
 __all__ = [
+    "CHAOS",
     "FAULTS",
+    "GEParams",
+    "LinkConditions",
+    "NetSim",
+    "Schedule",
+    "conditions",
+    "heal",
+    "partition",
+    "standard_scenarios",
     "UDPEndpoint",
     "create_client_endpoint",
     "create_server_endpoint",

@@ -13,8 +13,27 @@ negatives, staff.go:31,38) is fixed here: percentages are clamped to
 
 from __future__ import annotations
 
+import os
 import random
 import threading
+
+
+def env_chaos_seed():
+    """LSPNET_CHAOS_SEED as an int, or None if unset/unparseable — a typo
+    in an env knob must never crash every binary at import time."""
+    env = os.environ.get("LSPNET_CHAOS_SEED")
+    if not env:
+        return None
+    try:
+        return int(env)
+    except ValueError:
+        import sys
+
+        print(
+            f"lspnet: ignoring non-integer LSPNET_CHAOS_SEED={env!r}",
+            file=sys.stderr,
+        )
+        return None
 
 
 class _Faults:
@@ -30,7 +49,11 @@ class _Faults:
         self.msg_shorten = 0
         self.msg_lengthen = 0
         self.debug = False
-        self._rng = random.Random()
+        # Deterministic by default when LSPNET_CHAOS_SEED is set: any chaos
+        # failure is then replayable from the seed alone (the seed() knob
+        # below re-seeds at runtime; tools/chaos_replay.py drives both).
+        seed = env_chaos_seed()
+        self._rng = random.Random() if seed is None else random.Random(seed)
 
     # -- setters (lspnet/staff.go:18-75 surface) ----------------------------
 

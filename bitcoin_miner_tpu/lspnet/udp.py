@@ -25,6 +25,7 @@ import base64
 import json
 from typing import Optional, Tuple
 
+from .chaos import CHAOS
 from .faults import FAULTS
 
 Addr = Tuple[str, int]
@@ -81,10 +82,14 @@ class UDPEndpoint:
     def __init__(
         self, transport: asyncio.DatagramTransport, protocol: _QueueProtocol,
         is_server: bool, remote: Optional[Addr] = None,
+        label: Optional[str] = None,
     ) -> None:
         self._transport = transport
         self._protocol = protocol
         self.is_server = is_server
+        #: Chaos identity: lets the NetSim target this endpoint by name
+        #: (per-miner partitions etc.); None falls back to the role key.
+        self.label = label
         self._remote = remote
         self._closed = False
 
@@ -102,23 +107,55 @@ class UDPEndpoint:
                 if FAULTS.debug:
                     print(f"lspnet: DROPPING read packet of length {len(data)}")
                 continue
+            if CHAOS.on_recv(self.label, self.is_server):
+                continue  # rx-partitioned: consumed and discarded
             return data, addr
 
     def send(self, data: bytes, addr: Optional[Addr] = None) -> None:
         """Fire-and-forget datagram send (UDP semantics: no delivery
-        guarantee either way, so a dropped write still 'succeeds')."""
+        guarantee either way, so a dropped write still 'succeeds').
+
+        The chaos layer may drop, duplicate or delay the datagram; delays
+        are scheduled on the owning event loop (every LSP send happens on
+        its loop thread), so a delayed copy can land *after* packets sent
+        later — which is exactly how reordering reaches the wire."""
         if self._closed:
             return
         if FAULTS.sometimes(FAULTS.write_drop_percent(self.is_server)):
             if FAULTS.debug:
                 print(f"lspnet: DROPPING written packet of length {len(data)}")
             return
+        drop, dup, delay, _reordered = CHAOS.on_send(self.label, self.is_server)
+        if drop:
+            if FAULTS.debug:
+                print(f"lspnet: CHAOS dropped packet of length {len(data)}")
+            return
         data = _mutate_datagram(data)
         if addr is None:
             addr = self._remote
         if addr is None:
             raise ValueError("no destination address")
-        self._transport.sendto(data, addr)
+        copies = 2 if dup else 1
+        if delay > 0.0:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                loop = None  # not on a loop (shouldn't happen): send now
+            if loop is not None:
+                for _ in range(copies):
+                    loop.call_later(delay, self._send_late, data, addr)
+                return
+        for _ in range(copies):
+            self._transport.sendto(data, addr)
+
+    def _send_late(self, data: bytes, addr: Addr) -> None:
+        """Deliver a chaos-delayed datagram, unless we closed meanwhile."""
+        if self._closed:
+            return
+        try:
+            self._transport.sendto(data, addr)
+        except Exception:
+            pass  # transport torn down mid-delay: the packet is just lost
 
     def close(self) -> None:
         if not self._closed:
@@ -127,16 +164,20 @@ class UDPEndpoint:
             self._transport.close()
 
 
-async def create_server_endpoint(host: str = "127.0.0.1", port: int = 0) -> UDPEndpoint:
+async def create_server_endpoint(
+    host: str = "127.0.0.1", port: int = 0, label: Optional[str] = None
+) -> UDPEndpoint:
     """Bind a server-side endpoint (port 0 -> ephemeral)."""
     loop = asyncio.get_running_loop()
     transport, protocol = await loop.create_datagram_endpoint(
         _QueueProtocol, local_addr=(host, port)
     )
-    return UDPEndpoint(transport, protocol, is_server=True)
+    return UDPEndpoint(transport, protocol, is_server=True, label=label)
 
 
-async def create_client_endpoint(host: str, port: int) -> UDPEndpoint:
+async def create_client_endpoint(
+    host: str, port: int, label: Optional[str] = None
+) -> UDPEndpoint:
     """Create a client-side endpoint targeting ``host:port``.
 
     Not connect()ed at the OS level: we record the remote address instead,
@@ -148,4 +189,6 @@ async def create_client_endpoint(host: str, port: int) -> UDPEndpoint:
     transport, protocol = await loop.create_datagram_endpoint(
         _QueueProtocol, local_addr=("127.0.0.1" if host in ("127.0.0.1", "localhost") else "0.0.0.0", 0)
     )
-    return UDPEndpoint(transport, protocol, is_server=False, remote=(host, port))
+    return UDPEndpoint(
+        transport, protocol, is_server=False, remote=(host, port), label=label
+    )

@@ -49,7 +49,16 @@ class Metrics:
 #:   sched.miners_evicted      miners dropped after max_rejects strikes
 #:   sched.jobs_completed      Results sent back to clients
 #:   sched.jobs_resumed        jobs resumed from a checkpoint
+#:   sched.jobs_orphaned       dead clients' progress stashed for resubmit
 #:   miner.nonces              nonces swept by this process's miner loop
+#:   miner.reconnects          successful re-Joins after a lost server conn
+#:   miner.tier_downgrades     kernel tiers abandoned by the sweep watchdog
+#:   client.resubmits          jobs resubmitted after a lost client conn
+#:   chaos.dropped             packets dropped by the network simulator
+#:   chaos.partitioned         packets blackholed by a directional partition
+#:   chaos.duplicated          packets the simulator emitted twice
+#:   chaos.reordered           packets given the reorder extra delay
+#:   chaos.delayed             packets delivered late (delay/jitter/reorder)
 METRICS = Metrics()
 
 

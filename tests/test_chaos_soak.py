@@ -1,0 +1,484 @@
+"""Chaos soak suite: the seeded network-condition simulator + fleet
+self-healing, end to end.
+
+Three layers of assertion:
+
+1. **Engine determinism** — the same seed fed the same packet sequence
+   produces a bit-identical decision trace and counter totals (what makes
+   any chaos failure replayable via ``tools/chaos_replay.py --seed N``).
+2. **Self-healing units** — miner reconnect-with-backoff re-Joins across a
+   server restart; the watchdog downgrades a wedged kernel tier; a client
+   resubmit resumes from the scheduler's orphan stash.
+3. **Seeded fleet soaks** — full in-process client/server/miner fleets
+   under the standard schedules (burst loss, reorder/dup/delay, loss→
+   partition→heal, miner isolation + mid-job kill), every final Result
+   bit-exact against the hashlib oracle.
+
+One fast scenario stays in tier-1; the long soaks are marked ``slow``.
+"""
+
+import io
+import threading
+import time
+
+import pytest
+
+from bitcoin_miner_tpu import lsp, lspnet
+from bitcoin_miner_tpu.apps import client as client_mod
+from bitcoin_miner_tpu.apps import miner as miner_mod
+from bitcoin_miner_tpu.apps import server as server_mod
+from bitcoin_miner_tpu.apps.drill import run_drill
+from bitcoin_miner_tpu.apps.scheduler import Scheduler
+from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+from bitcoin_miner_tpu.bitcoin.message import Message
+from bitcoin_miner_tpu.lspnet.chaos import (
+    CHAOS,
+    GEParams,
+    NetSim,
+    Schedule,
+    conditions,
+    heal,
+    partition,
+)
+from bitcoin_miner_tpu.utils.metrics import METRICS
+
+from lsp_harness import random_port
+
+pytestmark = pytest.mark.chaos
+
+PARAMS = lsp.Params(epoch_limit=5, epoch_millis=100, window_size=5)
+
+
+@pytest.fixture(autouse=True)
+def _clean_network():
+    lspnet.reset_faults()
+    CHAOS.reset()
+    yield
+    CHAOS.reset()
+    lspnet.reset_faults()
+
+
+# --------------------------------------------------------------------------
+# 1. Engine determinism + model behavior (pure, no sockets)
+# --------------------------------------------------------------------------
+
+
+def _pump(sim: NetSim, clock: list, n: int = 600):
+    """Feed a fixed synthetic packet sequence through a simulator."""
+    sim.record_trace(True)
+    for i in range(n):
+        clock[0] = i * 0.01
+        sim.on_send("miner-1", False)
+        sim.on_send("server", True)
+        sim.on_recv("miner-1", False)
+    return sim.trace, sim.counters()
+
+
+def _scripted_sim(seed: int):
+    sim = NetSim()
+    sim.seed(seed)
+    clock = [0.0]
+    sched = (
+        Schedule()
+        .at(0.0, conditions(drop=15, duplicate=10, reorder=10, delay_ms=2,
+                            jitter_ms=3))
+        .at(2.0, conditions(ge=GEParams(p_enter_bad=3, p_exit_bad=12,
+                                        loss_bad=90)))
+        .at(4.0, partition("miner-1", "both"))
+        .at(5.0, heal())
+    )
+    sim.run(sched, clock=lambda: clock[0])
+    return sim, clock
+
+
+def test_seeded_fault_trace_replays_identically():
+    """The acceptance property: same seed + same packet sequence → the
+    identical fault trace, decision for decision, counter for counter."""
+    t1, c1 = _pump(*_scripted_sim(42))
+    t2, c2 = _pump(*_scripted_sim(42))
+    assert t1 == t2
+    assert c1 == c2
+    # The scenario actually exercised every fault class.
+    for key in ("dropped", "duplicated", "reordered", "delayed", "partitioned"):
+        assert c1.get(key, 0) > 0, (key, c1)
+    # A different seed diverges (the knobs really are driven by the seed).
+    t3, _ = _pump(*_scripted_sim(43))
+    assert t3 != t1
+
+
+def test_gilbert_elliott_loss_is_bursty():
+    """GE loss must arrive in runs (mean run ≈ 100/p_exit_bad packets),
+    not i.i.d. — the property that makes it a different failure mode."""
+    sim = NetSim()
+    sim.seed(7)
+    sim.set_conditions(ge=GEParams(p_enter_bad=2, p_exit_bad=10, loss_bad=100))
+    dropped = [sim.on_send(None, False)[0] for _ in range(5000)]
+    rate = sum(dropped) / len(dropped)
+    assert 0.05 < rate < 0.40, rate  # stationary ~1/6 at these params
+    runs, cur = [], 0
+    for d in dropped:
+        if d:
+            cur += 1
+        elif cur:
+            runs.append(cur)
+            cur = 0
+    mean_run = sum(runs) / len(runs)
+    assert mean_run > 3.0, mean_run  # i.i.d. at this rate would be ~1.2
+
+
+def test_schedule_steps_apply_in_time_order():
+    sim = NetSim()
+    sim.seed(1)
+    clock = [0.0]
+    sim.run(
+        Schedule()
+        .at(0.0, conditions(drop=100))
+        .at(5.0, conditions())
+        .at(10.0, partition("server", "tx"))
+        .at(15.0, heal()),
+        clock=lambda: clock[0],
+    )
+    assert sim.on_send(None, False)[0] is True  # 100% loss phase
+    clock[0] = 6.0
+    assert sim.on_send(None, False)[0] is False  # healed
+    clock[0] = 11.0
+    assert sim.on_send(None, True)[0] is True  # server tx partitioned
+    assert sim.on_send(None, False)[0] is False  # clients unaffected
+    clock[0] = 16.0
+    assert sim.on_send(None, True)[0] is False  # healed again
+
+
+def test_heal_does_not_pin_ambient_conditions():
+    """Partitioning an endpoint while ambient loss is installed, then
+    healing, must not leave the endpoint pinned to a stale copy of that
+    loss — partitions and conditions are orthogonal state."""
+    sim = NetSim()
+    sim.seed(5)
+    sim.set_conditions(drop=40)
+    sim.partition("server", "tx")
+    sim.set_conditions()  # heal the ambient loss
+    sim.heal("server")  # lift the partition
+    assert all(not sim.on_send(None, True)[0] for _ in range(200))
+    assert all(not sim.on_send("server", True)[0] for _ in range(200))
+
+
+def test_directional_partition_cuts_only_one_side():
+    sim = NetSim()
+    sim.seed(3)
+    sim.partition("miner-1", "rx")
+    assert sim.on_recv("miner-1", False) is True
+    assert sim.on_send("miner-1", False)[0] is False  # tx still flows
+    assert sim.on_recv("miner-2", False) is False  # peers unaffected
+    sim.heal("miner-1")
+    assert sim.on_recv("miner-1", False) is False
+
+
+# --------------------------------------------------------------------------
+# 2. Self-healing units
+# --------------------------------------------------------------------------
+
+
+def test_miner_reconnect_backoff_rejoins_after_server_restart():
+    """Acceptance drill: kill the server conn under the miner mid-chunk,
+    restart listening on the same port, and observe re-Join + new chunk
+    completion with no operator intervention."""
+    port = random_port()
+    reconnects0 = METRICS.get("miner.reconnects")
+    first_chunk = threading.Event()
+    hold = threading.Event()
+
+    def gated_search(d, lo, hi):
+        if not first_chunk.is_set():
+            first_chunk.set()
+            hold.wait(timeout=20)  # wedge the first chunk until the kill
+        return min_hash_range(d, lo, hi)
+
+    server1 = lsp.Server(port, PARAMS, label="server")
+    threading.Thread(
+        target=server_mod.serve, args=(server1, Scheduler(min_chunk=500)),
+        daemon=True,
+    ).start()
+    threading.Thread(
+        target=miner_mod.run_miner_resilient,
+        args=("127.0.0.1", port, gated_search),
+        kwargs={
+            "params": PARAMS, "max_retries": 15, "backoff_base": 0.05,
+            "backoff_cap": 0.3, "label": "miner-0",
+        },
+        daemon=True,
+    ).start()
+
+    out = {}
+
+    def run_client():
+        out["res"] = client_mod.request_with_retry(
+            "127.0.0.1", port, "rejoin", 2000,
+            retries=10, backoff_base=0.2, params=PARAMS, label="client-0",
+        )
+
+    ct = threading.Thread(target=run_client, daemon=True)
+    ct.start()
+    assert first_chunk.wait(timeout=30), "miner never got a chunk"
+    server1.close()  # the server conn dies under the miner mid-chunk
+    hold.set()
+    server2 = lsp.Server(port, PARAMS, label="server")
+    threading.Thread(
+        target=server_mod.serve, args=(server2, Scheduler(min_chunk=500)),
+        daemon=True,
+    ).start()
+    try:
+        ct.join(timeout=60)
+        assert not ct.is_alive(), "client starved after server restart"
+        assert out["res"] == min_hash_range("rejoin", 0, 2000)
+        assert METRICS.get("miner.reconnects") > reconnects0
+    finally:
+        server2.close()
+
+
+def test_resilient_miner_exits_on_backend_failure():
+    """A broken search backend must STOP a resilient miner — reconnecting
+    to a live server after a search failure would churn join/fail/assign
+    forever (the conn is fine; the compute is not)."""
+    server = lsp.Server(0, PARAMS)
+    threading.Thread(
+        target=server_mod.serve, args=(server, Scheduler(min_chunk=500)),
+        daemon=True,
+    ).start()
+
+    def broken(d, lo, hi):
+        raise RuntimeError("dead backend")
+
+    done = threading.Event()
+
+    def run():
+        miner_mod.run_miner_resilient(
+            "127.0.0.1", server.port, broken,
+            params=PARAMS, max_retries=5, backoff_base=0.05,
+        )
+        done.set()
+
+    threading.Thread(target=run, daemon=True).start()
+    try:
+        c = lsp.Client("127.0.0.1", server.port, PARAMS)
+        c.write(Message.request("doomed", 0, 5000).marshal())  # feeds a chunk
+        assert done.wait(timeout=30), "resilient miner churned instead of exiting"
+        c.close()
+    finally:
+        server.close()
+
+
+def test_watchdog_downgrades_wedged_then_broken_tiers():
+    """Pallas→XLA→hashlib in miniature: a tier that wedges and a tier that
+    raises are both abandoned; the chunk re-runs and completes on the
+    bottom tier."""
+    downgrades0 = METRICS.get("miner.tier_downgrades")
+    hold = threading.Event()
+
+    def wedged(d, lo, hi):
+        hold.wait(timeout=30)
+        return (0, 0)
+
+    def broken(d, lo, hi):
+        raise RuntimeError("simulated kernel failure")
+
+    ts = miner_mod._TieredSearch(
+        [("wedged", lambda: wedged), ("broken", lambda: broken),
+         ("oracle", lambda: min_hash_range)],
+        wedge_seconds=0.4,
+    )
+    try:
+        fut = ts.submit("tiers", 0, 500)
+        assert fut.result(timeout=30) == min_hash_range("tiers", 0, 500)
+        assert METRICS.get("miner.tier_downgrades") - downgrades0 == 2
+        assert ts.active_tier == "oracle"
+        # The downgraded chain keeps serving subsequent chunks directly.
+        assert ts.submit("tiers2", 0, 300).result(timeout=30) == (
+            min_hash_range("tiers2", 0, 300)
+        )
+    finally:
+        hold.set()
+        ts.close()
+
+
+def test_watchdog_miner_serves_fleet_after_downgrade():
+    """A fleet whose only miner starts on a wedging tier still answers —
+    run_miner never notices the tier swap happening beneath it."""
+    hold = threading.Event()
+
+    def wedged(d, lo, hi):
+        hold.wait(timeout=30)
+        return (0, 0)
+
+    server = lsp.Server(0, PARAMS)
+    threading.Thread(
+        target=server_mod.serve, args=(server, Scheduler(min_chunk=500)),
+        daemon=True,
+    ).start()
+    ts = miner_mod._TieredSearch(
+        [("wedged", lambda: wedged), ("cpu", lambda: miner_mod.make_search("cpu"))],
+        wedge_seconds=0.5,
+    )
+    mc = lsp.Client("127.0.0.1", server.port, PARAMS)
+    threading.Thread(
+        target=miner_mod.run_miner, args=(mc, ts), daemon=True
+    ).start()
+    try:
+        c = lsp.Client("127.0.0.1", server.port, PARAMS)
+        try:
+            res = client_mod.request_once(c, "wedgefleet", 2000)
+        finally:
+            c.close()
+        assert res == min_hash_range("wedgefleet", 0, 2000)
+    finally:
+        hold.set()
+        server.close()
+
+
+def test_client_resubmit_resumes_from_orphan_stash():
+    """Kill a client mid-job; the scheduler stashes the job's progress
+    under its (data, lower, upper) identity, and the resubmitted identical
+    Request resumes (jobs_resumed ticks) instead of restarting."""
+    orphaned0 = METRICS.get("sched.jobs_orphaned")
+    resumed0 = METRICS.get("sched.jobs_resumed")
+    server = lsp.Server(0, PARAMS)
+    # max_chunk pins the adaptive sizing so the job reliably outlives the
+    # client-death detection window (epoch_limit * epoch_seconds).
+    sched = Scheduler(min_chunk=300, max_chunk=300, straggler_min_seconds=30.0)
+    threading.Thread(
+        target=server_mod.serve, args=(server, sched), daemon=True
+    ).start()
+
+    def slow(d, lo, hi):
+        time.sleep(0.1)  # keep the job alive long enough to orphan it
+        return min_hash_range(d, lo, hi)
+
+    mc = lsp.Client("127.0.0.1", server.port, PARAMS)
+    threading.Thread(target=miner_mod.run_miner, args=(mc, slow), daemon=True).start()
+    try:
+        c1 = lsp.Client("127.0.0.1", server.port, PARAMS)
+        c1.write(Message.request("resume-me", 0, 12000).marshal())
+
+        def folded() -> bool:  # some real progress has landed
+            try:
+                return any(j.best is not None for j in list(sched.jobs.values()))
+            except RuntimeError:  # jobs dict resized mid-snapshot: retry
+                return False
+
+        deadline = time.time() + 20
+        while time.time() < deadline and not folded():
+            time.sleep(0.05)
+        assert folded()
+        c1.close()  # client dies mid-job
+        deadline = time.time() + 20
+        while time.time() < deadline and not sched._resume:
+            time.sleep(0.05)
+        assert METRICS.get("sched.jobs_orphaned") > orphaned0
+        res = client_mod.request_with_retry(
+            "127.0.0.1", server.port, "resume-me", 12000,
+            retries=2, params=PARAMS,
+        )
+        assert res == min_hash_range("resume-me", 0, 12000)
+        assert METRICS.get("sched.jobs_resumed") > resumed0
+    finally:
+        server.close()
+
+
+def test_client_disconnected_contract_under_total_drop(monkeypatch):
+    """Frozen L4 contract: 100% write drop mid-job (both directions dead)
+    must end with stdout exactly ``Disconnected`` — no retries by default,
+    no traceback, nothing else."""
+    # The CLI uses default LSP params (2 s epochs); swap in fast ones so
+    # loss detection fits the tier-1 budget without touching the contract.
+    real_client = lsp.Client
+    monkeypatch.setattr(
+        client_mod.lsp, "Client",
+        lambda host, port, params=None, label=None: real_client(
+            host, port, PARAMS, label=label
+        ),
+    )
+    server = lsp.Server(0, PARAMS)
+    threading.Thread(
+        target=server_mod.serve, args=(server, Scheduler()), daemon=True
+    ).start()  # no miners: the job can never finish
+    out = io.StringIO()
+    t = threading.Thread(
+        target=client_mod.main,
+        args=(["client", f"127.0.0.1:{server.port}", "x", "100000"],),
+        kwargs={"out": out},
+        daemon=True,
+    )
+    t.start()
+    time.sleep(0.5)  # request reaches the scheduler
+    lspnet.set_write_drop_percent(100)
+    try:
+        t.join(timeout=30)
+        assert not t.is_alive(), "client never detected the dead conn"
+        assert out.getvalue() == "Disconnected\n"
+    finally:
+        lspnet.reset_faults()
+        server.close()
+
+
+# --------------------------------------------------------------------------
+# 3. Seeded fleet soaks (oracle bit-exactness under chaos)
+# --------------------------------------------------------------------------
+
+
+def test_fast_seeded_scenario_oracle_exact():
+    """The tier-1 chaos gate: a small fleet rides out a seeded burst-loss
+    schedule and the Result is bit-exact.  Fails?  Replay it:
+    ``python tools/chaos_replay.py --scenario burst-loss --seed 11``."""
+    report = run_drill(
+        "burst-loss", seed=11, data="fastchaos", max_nonce=2500,
+        n_miners=2, timeout=90.0,
+    )
+    assert report.ok, report.as_dict()
+    assert report.counters.get("chaos.dropped", 0) > 0, report.as_dict()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "scenario,seed,kill_at",
+    [
+        ("burst-loss", 101, None),
+        ("reorder-dup-delay", 202, None),
+        ("flaky-then-partition", 303, None),
+        ("miner-partition", 404, 0.8),  # isolation + mid-job miner kill
+    ],
+)
+def test_chaos_soak_schedules(scenario, seed, kill_at):
+    """The long soaks: every standard schedule (plus a mid-job kill of the
+    non-resilient miner in the partition scenario) must still produce the
+    oracle-exact Result through reassignment, re-Join and resubmission."""
+    report = run_drill(
+        scenario, seed=seed, data=f"soak-{scenario}", max_nonce=6000,
+        n_miners=3, kill_miner_at=kill_at, timeout=180.0,
+    )
+    assert report.ok, report.as_dict()
+
+
+@pytest.mark.slow
+def test_chaos_replay_tool_smoke():
+    """tools/chaos_replay.py end to end: --list names the scenarios and a
+    tiny replayed drill reports ok=true with a zero exit."""
+    import json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    listing = subprocess.run(
+        [sys.executable, str(repo / "tools" / "chaos_replay.py"), "--list"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert listing.returncode == 0 and "burst-loss" in listing.stdout
+    run = subprocess.run(
+        [sys.executable, str(repo / "tools" / "chaos_replay.py"),
+         "--scenario", "burst-loss", "--seed", "5", "--max-nonce", "1500"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert run.returncode == 0, run.stdout + run.stderr
+    report = json.loads(run.stdout.strip().splitlines()[-1])
+    assert report["ok"] is True

@@ -1,0 +1,132 @@
+"""Checkpoint durability: a crash at any point in the save path must never
+let ``load_checkpoint`` observe a torn state.
+
+``save_checkpoint`` is write-temp-then-``os.replace`` — the only atomic
+primitive POSIX gives us.  These tests crash-inject at each step of that
+sequence (mid-``json.dump``, between temp write and replace, inside
+``os.replace`` itself) and feed the loader every flavor of corrupt file a
+real crash can leave behind (truncated JSON, binary garbage, a stale
+``.tmp`` sibling, a non-dict top level).  In every case the loader must
+return either the *previous complete snapshot* or None — never a mix.
+"""
+
+import json
+import os
+
+from bitcoin_miner_tpu.apps.scheduler import Scheduler
+from bitcoin_miner_tpu.apps.server import load_checkpoint, save_checkpoint
+
+STATE_1 = {"version": 1, "jobs": [
+    {"data": "a", "lower": 0, "upper": 99, "best": [5, 7],
+     "remaining": [[8, 99]]},
+]}
+STATE_2 = {"version": 1, "jobs": [
+    {"data": "a", "lower": 0, "upper": 99, "best": [3, 42],
+     "remaining": [[50, 99]]},
+]}
+
+
+def test_crash_inside_replace_keeps_previous_state(tmp_path, monkeypatch):
+    """os.replace dies (disk full, power cut): the previous snapshot must
+    survive byte-identically."""
+    path = str(tmp_path / "ckpt.json")
+    save_checkpoint(path, STATE_1)
+
+    def exploding_replace(src, dst):
+        raise OSError("crash-injected between temp write and replace")
+
+    monkeypatch.setattr(os, "replace", exploding_replace)
+    try:
+        save_checkpoint(path, STATE_2)
+    except OSError:
+        pass
+    monkeypatch.undo()
+    assert load_checkpoint(path) == STATE_1
+
+
+def test_crash_mid_json_dump_keeps_previous_state(tmp_path, monkeypatch):
+    """The temp write itself dies halfway: the half-written temp file must
+    not shadow or corrupt the real checkpoint."""
+    path = str(tmp_path / "ckpt.json")
+    save_checkpoint(path, STATE_1)
+    real_dump = json.dump
+
+    def torn_dump(obj, f, **kw):
+        f.write('{"version": 1, "jobs": [{"da')  # partial bytes, then crash
+        raise OSError("crash-injected mid-write")
+
+    monkeypatch.setattr(json, "dump", torn_dump)
+    try:
+        save_checkpoint(path, STATE_2)
+    except OSError:
+        pass
+    monkeypatch.setattr(json, "dump", real_dump)
+    assert load_checkpoint(path) == STATE_1
+
+
+def test_stale_tmp_sibling_is_never_loaded(tmp_path):
+    """A crash can orphan ``<path>.tmp``; the loader must read only the
+    committed file."""
+    path = str(tmp_path / "ckpt.json")
+    save_checkpoint(path, STATE_1)
+    with open(path + ".tmp", "w") as f:
+        f.write('{"version": 1, "jobs": [{"TORN')
+    assert load_checkpoint(path) == STATE_1
+
+
+def test_missing_file_returns_none(tmp_path):
+    assert load_checkpoint(str(tmp_path / "nope.json")) is None
+
+
+def test_truncated_json_returns_none(tmp_path):
+    path = str(tmp_path / "ckpt.json")
+    with open(path, "w") as f:
+        f.write('{"version": 1, "jobs": [')
+    assert load_checkpoint(path) is None
+
+
+def test_binary_garbage_returns_none(tmp_path):
+    path = str(tmp_path / "ckpt.json")
+    with open(path, "wb") as f:
+        f.write(b"\xff\xfe\x00garbage\x9c")  # not even valid UTF-8
+    assert load_checkpoint(path) is None
+
+
+def test_non_dict_top_level_returns_none(tmp_path):
+    path = str(tmp_path / "ckpt.json")
+    with open(path, "w") as f:
+        f.write('["valid", "json", "wrong", "shape"]')
+    assert load_checkpoint(path) is None
+
+
+def test_unreadable_file_returns_none(tmp_path):
+    path = str(tmp_path / "ckpt.json")
+    save_checkpoint(path, STATE_1)
+    os.chmod(path, 0o000)
+    try:
+        if os.access(path, os.R_OK):  # running as root: chmod is a no-op
+            return
+        assert load_checkpoint(path) is None
+    finally:
+        os.chmod(path, 0o644)
+
+
+def test_scheduler_resumes_from_survivor_after_torn_save(tmp_path, monkeypatch):
+    """End to end: a checkpoint that survived a torn save still resumes a
+    matching Request — the loader/scheduler pair never see the crash."""
+    path = str(tmp_path / "ckpt.json")
+    save_checkpoint(path, STATE_1)
+    monkeypatch.setattr(
+        os, "replace",
+        lambda s, d: (_ for _ in ()).throw(OSError("crash-injected")),
+    )
+    try:
+        save_checkpoint(path, STATE_2)
+    except OSError:
+        pass
+    monkeypatch.undo()
+    sched = Scheduler(min_chunk=10, resume_state=load_checkpoint(path))
+    actions = sched.client_request(1, "a", 0, 99)
+    assert sched.jobs[1].best == (5, 7)  # STATE_1's best, not STATE_2's
+    assert list(sched.jobs[1].pending) == [(8, 99)]
+    assert actions == []  # no miners yet: nothing to dispatch

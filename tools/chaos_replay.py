@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Replay a chaos-soak scenario from the command line.
+
+A failing chaos test prints its ``(scenario, seed)`` pair; this tool
+re-runs that exact drill — same seeded fault decisions, same fleet shape —
+outside pytest, so a failure can be bisected with extra logging or under a
+debugger:
+
+    python tools/chaos_replay.py --scenario burst-loss --seed 1234
+    python tools/chaos_replay.py --list
+    python tools/chaos_replay.py --scenario miner-partition --seed 7 \
+        --miners 3 --kill-at 0.5 --max-nonce 8000 -v
+
+Prints one JSON report line (the drill's oracle verdict + chaos/self-
+healing counter totals) and exits non-zero on an oracle mismatch, so it
+slots into shell bisection loops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--scenario", default="burst-loss",
+                        help="named schedule from lspnet.standard_scenarios")
+    parser.add_argument("--seed", type=int, default=1)
+    parser.add_argument("--data", default="chaos")
+    parser.add_argument("--max-nonce", type=int, default=4000)
+    parser.add_argument("--miners", type=int, default=2)
+    parser.add_argument("--kill-at", type=float, default=None,
+                        help="kill miner-0's conn this many seconds in")
+    parser.add_argument("--epoch-millis", type=int, default=100)
+    parser.add_argument("--timeout", type=float, default=120.0)
+    parser.add_argument("--list", action="store_true",
+                        help="list scenario names and exit")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="enable lspnet debug drop logging")
+    args = parser.parse_args(argv)
+
+    # Import after the path fix so the tool runs from any cwd.
+    from bitcoin_miner_tpu import lspnet
+    from bitcoin_miner_tpu.apps.drill import run_drill
+
+    if args.list:
+        for name, sched in lspnet.standard_scenarios().items():
+            print(f"{name:24s} {sched.desc}")
+        return 0
+    if args.verbose:
+        lspnet.enable_debug_logs(True)
+    try:
+        report = run_drill(
+            args.scenario,
+            seed=args.seed,
+            data=args.data,
+            max_nonce=args.max_nonce,
+            n_miners=args.miners,
+            kill_miner_at=args.kill_at,
+            epoch_millis=args.epoch_millis,
+            timeout=args.timeout,
+        )
+    except ValueError as e:  # e.g. a typoed --scenario name
+        print(f"chaos_replay: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(report.as_dict()))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
