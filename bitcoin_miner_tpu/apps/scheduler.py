@@ -51,7 +51,14 @@ Design notes (deliberately not a translation of anything):
   retry-with-resubmit self-healing.
 - **Lowest-nonce tie-break** on equal min-hashes, matching the kernels
   (BASELINE.md).
-- **Fairness**: round-robin across jobs with pending work.
+- **Fairness**: weighted fair queueing across *tenants* (start-time
+  virtual-clock WFQ).  Each job belongs to a tenant (default: its own
+  conn, which degrades to per-job round-robin); the gateway groups all of
+  one client's jobs under one tenant key, so a tenant flooding N jobs
+  still gets one tenant's share of nonce throughput — assignment picks
+  the lowest-virtual-time tenant and charges it ``chunk_size / weight``.
+  A newly active tenant starts at the minimum active virtual time, so it
+  neither starves the incumbents nor inherits a starvation debt.
 """
 
 from __future__ import annotations
@@ -106,11 +113,23 @@ class _Miner:
 
 
 @dataclass
+class _Tenant:
+    """One fair-queue principal: the unit WFQ shares throughput across."""
+
+    key: str
+    weight: float = 1.0
+    vt: float = 0.0  # virtual time: sum of charged nonces / weight
+    seq: int = 0  # creation order (deterministic vt tie-break)
+    jobs: Deque[int] = field(default_factory=deque)  # client ids, RR order
+
+
+@dataclass
 class _Job:
     client_id: int
     data: str
     lower: int
     upper: int
+    tenant: str = ""
     pending: Deque[Interval] = field(default_factory=deque)
     # conn_id -> intervals that miner holds (pipeline: possibly several).
     outstanding: Dict[int, List[Interval]] = field(default_factory=dict)
@@ -175,7 +194,8 @@ class Scheduler:
         self.orphan_cache_max = orphan_cache_max
         self.miners: Dict[int, _Miner] = {}
         self.jobs: Dict[int, _Job] = {}
-        self._job_rr: Deque[int] = deque()  # round-robin order of job ids
+        self._tenants: Dict[str, _Tenant] = {}  # WFQ principals (see _next_job)
+        self._tenant_seq = 0
         self._banned: set = set()  # evicted conn ids: Joins refused for good
         self._evicted: List[int] = []  # conns the shell should close
         #: Bumped by every state-mutating event; lets the server shell skip
@@ -199,14 +219,27 @@ class Scheduler:
         return self._dispatch(now)
 
     def client_request(
-        self, conn_id: int, data: str, lower: int, upper: int, now: float = 0.0
+        self,
+        conn_id: int,
+        data: str,
+        lower: int,
+        upper: int,
+        now: float = 0.0,
+        tenant: Optional[str] = None,
+        weight: float = 1.0,
     ) -> List[Action]:
+        """``tenant``/``weight`` name the fair-queue principal this job is
+        charged to (the gateway passes its per-client key); default is the
+        conn itself, i.e. every job its own equal-share tenant."""
         self.revision += 1
         if conn_id in self.jobs or conn_id in self.miners:
             return []  # one job per client conn; ignore repeats
         if lower < 0 or upper >= 1 << 64:
             return []  # defense in depth; Message.unmarshal already rejects
-        job = _Job(client_id=conn_id, data=data, lower=lower, upper=upper)
+        job = _Job(
+            client_id=conn_id, data=data, lower=lower, upper=upper,
+            tenant=tenant or f"conn:{conn_id}",
+        )
         resumed = self._resume.pop(job.key, None)
         if resumed is not None:
             best, remaining = resumed
@@ -215,13 +248,11 @@ class Scheduler:
             METRICS.inc("sched.jobs_resumed")
         elif lower <= upper:
             job.pending.append((lower, upper))
-        self.jobs[conn_id] = job
-        self._job_rr.append(conn_id)
         if job.done:  # empty range, or checkpoint says fully swept
-            del self.jobs[conn_id]
-            self._job_rr.remove(conn_id)
             best = job.best or (0, 0)
             return [(conn_id, Message.result(best[0], best[1]))]
+        self.jobs[conn_id] = job
+        self._tenant_add(job.tenant, conn_id, weight)
         return self._dispatch(now)
 
     def result(
@@ -256,6 +287,10 @@ class Scheduler:
         )
         miner.last_size = size
         miner.last_elapsed = elapsed
+        # Server-side throughput surface: every accepted chunk's nonces.
+        # The ticker's sliding-window RateMeter over this counter is the
+        # health line's "recent nonces/sec" (utils/metrics.RateMeter).
+        METRICS.inc("sched.nonces_swept", size)
         if miner.queue:
             nxt = miner.queue[0]
             nxt.started_at = max(nxt.started_at, now)
@@ -303,8 +338,7 @@ class Scheduler:
             return self._dispatch(now)
         job = self.jobs.pop(conn_id, None)
         if job is not None:
-            if conn_id in self._job_rr:
-                self._job_rr.remove(conn_id)
+            self._tenant_remove(job)
             # Outstanding miners keep crunching; their Results will find no
             # job and simply idle them (see result()).
             # Stash the job's progress under its (data, lower, upper)
@@ -464,7 +498,7 @@ class Scheduler:
 
     def _finish_job(self, job: _Job) -> Action:
         del self.jobs[job.client_id]
-        self._job_rr.remove(job.client_id)
+        self._tenant_remove(job)
         assert job.best is not None
         METRICS.inc("sched.jobs_completed")
         return (job.client_id, Message.result(job.best[0], job.best[1]))
@@ -485,11 +519,49 @@ class Scheduler:
             size = max(size, miner.last_size * self.ramp_factor)
         return max(self.min_chunk, min(size, self.max_chunk))
 
+    def _tenant_add(self, key: str, conn_id: int, weight: float) -> None:
+        t = self._tenants.get(key)
+        if t is None:
+            # A newly active tenant starts at the minimum active virtual
+            # time: it cannot starve incumbents by arriving with vt=0 debt,
+            # and it does not inherit charges it never incurred.
+            floor = min(
+                (x.vt for x in self._tenants.values() if x.jobs), default=0.0
+            )
+            t = self._tenants[key] = _Tenant(
+                key=key, weight=max(weight, 1e-9), vt=floor,
+                seq=self._tenant_seq,
+            )
+            self._tenant_seq += 1
+        else:
+            t.weight = max(weight, 1e-9)  # latest submission's weight wins
+        t.jobs.append(conn_id)
+
+    def _tenant_remove(self, job: _Job) -> None:
+        t = self._tenants.get(job.tenant)
+        if t is not None:
+            if job.client_id in t.jobs:
+                t.jobs.remove(job.client_id)
+            if not t.jobs:
+                del self._tenants[t.key]
+
     def _next_job(self) -> Optional[_Job]:
-        """Round-robin over jobs that still have pending work."""
-        for _ in range(len(self._job_rr)):
-            cid = self._job_rr[0]
-            self._job_rr.rotate(-1)
+        """Weighted fair queueing: among tenants with pending work, pick the
+        lowest virtual time (creation order breaks ties deterministically),
+        then round-robin within that tenant's jobs.  ``_dispatch`` charges
+        the tenant ``chunk_size / weight`` per carved chunk, so a tenant
+        flooding many jobs gets one tenant's share, not N jobs' worth."""
+        best: Optional[_Tenant] = None
+        for t in self._tenants.values():
+            if best is not None and (t.vt, t.seq) >= (best.vt, best.seq):
+                continue
+            if any(self.jobs[cid].pending for cid in t.jobs):
+                best = t
+        if best is None:
+            return None
+        for _ in range(len(best.jobs)):
+            cid = best.jobs[0]
+            best.jobs.rotate(-1)
             job = self.jobs[cid]
             if job.pending:
                 return job
@@ -523,6 +595,9 @@ class Scheduler:
                 cut = min(hi, lo + size - 1)
                 if cut < hi:
                     job.pending.appendleft((cut + 1, hi))
+                t = self._tenants.get(job.tenant)
+                if t is not None:  # WFQ charge: carved nonces / weight
+                    t.vt += (cut - lo + 1) / t.weight
                 # A queued (not-yet-front) assignment starts its clock when
                 # it reaches the front (see result()); until then its
                 # started_at only matters if the queue is empty now.
@@ -554,6 +629,7 @@ class Scheduler:
             "miners": len(self.miners),
             "idle_miners": sum(1 for m in self.miners.values() if not m.queue),
             "jobs": len(self.jobs),
+            "tenants": len(self._tenants),
             "pending_intervals": sum(len(j.pending) for j in self.jobs.values()),
             "outstanding_chunks": sum(
                 len(lst)

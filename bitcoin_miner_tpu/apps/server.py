@@ -15,7 +15,6 @@ actions are put on the wire.
 
 from __future__ import annotations
 
-import json
 import logging
 import os
 import sys
@@ -25,30 +24,15 @@ from typing import Optional
 
 from .. import lsp
 from ..bitcoin.message import Message, MsgType
+from ..utils.metrics import RateMeter
+from ..utils.persist import load_json, save_json_atomic
 from .scheduler import Scheduler
 
-
-def save_checkpoint(path: str, state: dict) -> None:
-    """Atomically persist a scheduler checkpoint (write temp + rename, so a
-    crash mid-write never corrupts the resume file)."""
-    tmp = f"{path}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(state, f)
-    os.replace(tmp, path)
-
-
-def load_checkpoint(path: str) -> Optional[dict]:
-    """None (a fresh start) on any unreadable state: missing file, torn or
-    truncated JSON, undecodable bytes, permission errors.  save_checkpoint's
-    temp-write + os.replace guarantees the file is never *partially* new —
-    a crash between the two leaves the previous complete snapshot."""
-    try:
-        with open(path) as f:
-            state = json.load(f)
-    # ValueError covers JSONDecodeError and UnicodeDecodeError both.
-    except (OSError, ValueError):
-        return None
-    return state if isinstance(state, dict) else None
+# The atomic temp-write + rename path now lives in utils/persist.py (the
+# gateway's result cache shares it); these names stay as the checkpoint
+# API every caller and test already uses.
+save_checkpoint = save_json_atomic
+load_checkpoint = load_json
 
 
 def serve(
@@ -71,11 +55,23 @@ def serve(
     sched = scheduler if scheduler is not None else Scheduler()
     log = log or logging.getLogger("bitcoin_miner_tpu.server")
     lock = threading.Lock()  # serializes scheduler access with the ticker
+    # A gateway-wrapped scheduler carries a result cache; its disk flushes
+    # ride this ticker (snapshot under the lock, write outside) just like
+    # the checkpoint — never on the per-job event path.
+    cache = getattr(sched, "cache", None)
+    if cache is not None and getattr(cache, "path", None) is None:
+        cache = None  # in-memory only: nothing to flush
     # Operator health surface (the reference's LOGF scaffold,
     # bitcoin/server/server.go:26-39, implies exactly this): periodic
     # scheduler stats + recovery counters in log.txt, so reassignment/
     # validation/straggler machinery is visible without a debugger.
     health_every = max(1, int(round(health_interval / tick_interval)))
+    # Recent delivered nonces/sec for the health line: a sliding window, so
+    # the number tracks the fleet's CURRENT rate after reconnects and tier
+    # downgrades instead of a lifetime average that goes stale (bench JSON
+    # keeps using lifetime numbers — see utils/metrics.RateMeter).
+    recent_nps = RateMeter(clock=clock, window=max(3 * health_interval, 10.0))
+    swept_seen = [None]  # last sched.nonces_swept sample (None = first tick)
 
     def health_line() -> str:
         from ..utils.metrics import METRICS
@@ -93,18 +89,20 @@ def serve(
                 "jobs_orphaned",
             )
         }
-        # Chaos + self-healing counters (packets dropped/reordered/…, miner
-        # reconnects, tier downgrades, client resubmits) ride the same line
-        # so a soak's fault trace is visible in log.txt without a debugger.
-        # Only non-zero ones print — a healthy fleet's line stays short.
-        chaos = {
+        # Chaos + self-healing + gateway counters (packets dropped, miner
+        # reconnects, tier downgrades, client resubmits, coalesce/cache/
+        # shed decisions) ride the same line so a soak's fault trace and
+        # the serving layer's traffic shape are visible in log.txt without
+        # a debugger.  Only non-zero ones print — a healthy, gateway-less
+        # fleet's line stays short.
+        extra = {
             k: v
             for k, v in sorted(METRICS.snapshot().items())
-            if v and k.startswith(("chaos.", "miner.reconnects",
+            if v and k.startswith(("chaos.", "gateway.", "miner.reconnects",
                                    "miner.tier_downgrades", "client.resubmits"))
         }
-        line = f"health {sched.stats()} {counters}"
-        return f"{line} chaos {chaos}" if chaos else line
+        line = f"health {sched.stats()} {counters} nps={recent_nps.rate():.3g}"
+        return f"{line} extra {extra}" if extra else line
 
     def emit(actions) -> None:
         for conn_id, msg in actions:
@@ -124,6 +122,12 @@ def serve(
         while not stop.wait(tick_interval):
             try:
                 ticks += 1
+                from ..utils.metrics import METRICS
+
+                swept = METRICS.get("sched.nonces_swept")
+                if swept_seen[0] is not None and swept > swept_seen[0]:
+                    recent_nps.add(swept - swept_seen[0])
+                swept_seen[0] = swept
                 with lock:
                     actions = sched.tick(clock())
                     rev = sched.revision
@@ -132,6 +136,7 @@ def serve(
                         if checkpoint_path and rev != saved_rev
                         else None
                     )
+                    cache_state = cache.flush() if cache is not None else None
                     line = (
                         health_line() if ticks % health_every == 0 else None
                     )
@@ -144,6 +149,16 @@ def serve(
                 if state is not None:
                     save_checkpoint(checkpoint_path, state)
                     saved_rev = rev
+                if cache_state is not None:
+                    try:
+                        save_checkpoint(cache.path, cache_state)
+                    except Exception:
+                        # Re-arm so the NEXT tick retries even if no new
+                        # result dirties the cache meanwhile (the
+                        # checkpoint's only-advance-saved_rev-on-success
+                        # contract, in dirty-flag form).
+                        cache.mark_dirty()
+                        raise
             except Exception:
                 # A transient failure (e.g. checkpoint disk full) must not
                 # silently kill straggler recovery for the server's lifetime.
@@ -196,6 +211,15 @@ def serve(
     finally:
         stop.set()
         tick_thread.join(timeout=2 * tick_interval + 1)
+        if cache is not None:
+            # Final flush: a Result delivered just before shutdown must not
+            # miss the file because no tick fired after it.
+            cache_state = cache.flush()
+            if cache_state is not None:
+                try:
+                    save_checkpoint(cache.path, cache_state)
+                except OSError:
+                    log.exception("final result-cache flush failed")
 
 
 def main(argv=None) -> int:
@@ -206,12 +230,38 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(filename)s:%(lineno)d %(message)s",
     )
-    # Beyond-parity flag: --checkpoint FILE persists job progress for resume.
+    # Beyond-parity flags (same idiom as --checkpoint=FILE): --gateway arms
+    # the serving layer (coalescing + result cache + admission control);
+    # --cache=FILE persists the result cache (implies --gateway); --rate /
+    # --burst / --max-queued tune admission (README "Serving gateway").
     checkpoint_path = None
+    gateway_on = False
+    cache_path = None
+    rate: Optional[float] = 5.0
+    burst = 10.0
+    max_queued = 256
     pos = []
     for a in argv[1:]:
         if a.startswith("--checkpoint="):
             checkpoint_path = a.split("=", 1)[1]
+        elif a == "--gateway":
+            gateway_on = True
+        elif a.startswith("--cache="):
+            gateway_on = True
+            cache_path = a.split("=", 1)[1]
+        elif a.startswith(("--rate=", "--burst=", "--max-queued=")):
+            gateway_on = True  # admission knobs imply the gateway, like --cache
+            name, _, val = a.partition("=")
+            try:
+                if name == "--rate":
+                    rate = float(val) or None  # 0 = unlimited
+                elif name == "--burst":
+                    burst = float(val)
+                else:
+                    max_queued = int(val)
+            except ValueError:
+                print(f"{a} is not a number.")
+                return 0
         else:
             pos.append(a)
     if len(pos) != 1:
@@ -228,8 +278,32 @@ def main(argv=None) -> int:
         print(str(e))
         return 0
     print("Server listening on port", port)
+    # Degraded-network bench support (tools/fleet_bench.py --chaos): arm a
+    # named seeded scenario in THIS process — the server's tx shapes both
+    # the chunk stream to miners and the Result stream to clients.
+    scenario = os.environ.get("BMT_CHAOS_SCENARIO")
+    if scenario:
+        from ..lspnet.chaos import CHAOS, standard_scenarios
+
+        library = standard_scenarios()
+        if scenario in library:
+            loop = float(os.environ.get("BMT_CHAOS_LOOP", "0") or 0)
+            CHAOS.run(library[scenario], loop_every=loop or None)
+        else:
+            print(f"unknown BMT_CHAOS_SCENARIO {scenario!r}; ignoring",
+                  file=sys.stderr)
     resume = load_checkpoint(checkpoint_path) if checkpoint_path else None
     sched = Scheduler(resume_state=resume)
+    if gateway_on:
+        from ..gateway import Gateway, ResultCache
+
+        sched = Gateway(
+            sched,
+            cache=ResultCache(path=cache_path),
+            rate=rate,
+            burst=burst,
+            max_queued=max_queued,
+        )
     try:
         serve(server, scheduler=sched, checkpoint_path=checkpoint_path)
     finally:

@@ -1,0 +1,14 @@
+"""Serving gateway (L4.5): the traffic layer between transport and scheduler.
+
+The scheduler answers "how do I split this job across miners"; the gateway
+answers "which of the requests hammering the door should become jobs at
+all" — request coalescing, a content-addressed result cache, and admission
+control (token buckets + fair queueing + load shedding).  See
+:mod:`.core` for the full design notes.
+"""
+
+from .admission import FairQueue, TokenBucket
+from .cache import ResultCache
+from .core import Gateway
+
+__all__ = ["FairQueue", "Gateway", "ResultCache", "TokenBucket"]

@@ -1,0 +1,140 @@
+"""Admission control primitives: token buckets and a request fair queue.
+
+Pure policy, like the scheduler: every decision takes an explicit ``now``
+and mutates only its own counters, so the whole admission path unit-tests
+without clocks or sockets and stays deterministic under the chaos layer.
+
+- :class:`TokenBucket` — classic continuous-refill bucket, one per client
+  key.  A request costs one token; an empty bucket means "queue, don't
+  dispatch" (backpressure), never "busy-wait".
+- :class:`FairQueue` — weighted fair queue of *queued requests* across
+  client keys (request granularity; the scheduler's WFQ handles nonce
+  granularity once jobs are admitted).  Start-time virtual-clock WFQ, the
+  same scheme as ``Scheduler._next_job``: pop takes the lowest-virtual-time
+  key's oldest request and charges ``1 / weight``; a newly active key
+  starts at the minimum active virtual time.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+
+class TokenBucket:
+    """``rate`` tokens/sec up to ``burst``; starts full (a fresh client can
+    burst immediately — that is what the burst allowance is for)."""
+
+    def __init__(self, rate: float, burst: float, now: float = 0.0) -> None:
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self.tokens = self.burst
+        self._last = now
+
+    def _refill(self, now: float) -> None:
+        if now > self._last:
+            self.tokens = min(self.burst, self.tokens + (now - self._last) * self.rate)
+        self._last = max(self._last, now)
+
+    def try_take(self, now: float, n: float = 1.0) -> bool:
+        self._refill(now)
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+    def is_full(self, now: float) -> bool:
+        """True once refilled to burst — behaviorally identical to a fresh
+        bucket, so the owner may drop it (bounded per-client state)."""
+        self._refill(now)
+        return self.tokens >= self.burst
+
+
+class _KeyQueue:
+    __slots__ = ("weight", "vt", "seq", "items")
+
+    def __init__(self, weight: float, vt: float, seq: int) -> None:
+        self.weight = weight
+        self.vt = vt
+        self.seq = seq
+        self.items: Deque[tuple] = deque()
+
+
+class FairQueue:
+    """Weighted fair queue of opaque items across client keys (see module
+    docstring).  Items are anything; the gateway queues pending-request
+    tuples.  ``__len__`` is the total backlog across every key."""
+
+    def __init__(self) -> None:
+        self._keys: Dict[str, _KeyQueue] = {}
+        self._seq = 0
+        self._len = 0
+
+    def __len__(self) -> int:
+        return self._len
+
+    def push(self, key: str, item: tuple, weight: float = 1.0) -> None:
+        kq = self._keys.get(key)
+        if kq is None:
+            floor = min(
+                (k.vt for k in self._keys.values() if k.items), default=0.0
+            )
+            kq = self._keys[key] = _KeyQueue(max(weight, 1e-9), floor, self._seq)
+            self._seq += 1
+        else:
+            kq.weight = max(weight, 1e-9)
+        kq.items.append(item)
+        self._len += 1
+
+    def pop(self) -> Optional[Tuple[str, tuple]]:
+        best: Optional[_KeyQueue] = None
+        best_key = None
+        for key, kq in self._keys.items():
+            if kq.items and (
+                best is None or (kq.vt, kq.seq) < (best.vt, best.seq)
+            ):
+                best, best_key = kq, key
+        if best is None:
+            return None
+        item = best.items.popleft()
+        best.vt += 1.0 / best.weight
+        self._len -= 1
+        if not best.items:
+            del self._keys[best_key]
+        return best_key, item
+
+    def shed_from_largest(self) -> Optional[tuple]:
+        """Backlog-overflow victim selection: remove and return the NEWEST
+        item of the key holding the most queued requests — the flood pays
+        for the overflow it caused, not whoever arrives next.  Returns
+        None when no key is over-represented (max backlog 1 per key, e.g.
+        per-conn keys): the caller falls back to shedding the arrival,
+        since every key then has an equal, minimal claim."""
+        victim_key = None
+        victim: Optional[_KeyQueue] = None
+        for key, kq in self._keys.items():
+            if len(kq.items) >= 2 and (
+                victim is None or len(kq.items) > len(victim.items)
+            ):
+                victim_key, victim = key, kq
+        if victim is None:
+            return None
+        item = victim.items.pop()
+        self._len -= 1
+        if not victim.items:
+            del self._keys[victim_key]
+        return item
+
+    def remove_where(self, pred) -> int:
+        """Drop every queued item matching ``pred`` (e.g. a dead conn's
+        requests); returns how many were removed."""
+        removed = 0
+        for key in list(self._keys):
+            kq = self._keys[key]
+            kept = deque(i for i in kq.items if not pred(i))
+            removed += len(kq.items) - len(kept)
+            kq.items = kept
+            if not kept:
+                del self._keys[key]
+        self._len -= removed
+        return removed

@@ -1,0 +1,115 @@
+"""Content-addressed result cache — solved jobs answer without device work.
+
+The argmin over a fixed ``(data, lower, upper)`` range is a pure function,
+so a completed job's ``(hash, nonce)`` is cacheable forever under that
+signature — the same identity the scheduler's checkpoint/orphan resume
+machinery already keys on.  The gateway consults this cache before
+anything touches the scheduler: a repeat of a solved job costs one
+dictionary lookup and one Result send, zero chunks assigned.
+
+In-memory LRU with optional disk persistence through the shared atomic
+temp-write + rename path (utils/persist.py — the same torn-write contract
+as the scheduler checkpoint).  Persistence is dirty-flagged, not
+write-through: mutations mark the cache dirty and the server shell's
+ticker snapshots+writes at most once per tick (``flush()`` under the
+event lock, ``save_json_atomic`` outside it — the same cadence as the
+scheduler checkpoint), so completing a job costs O(1) disk work instead
+of rewriting an up-to-capacity file on the hot path.  A restarted
+gateway reloads the file, so solved-job answers survive fleet restarts
+alongside the scheduler's partial-progress checkpoint.  Evictions bump
+``gateway.cache_evictions``; hit/miss accounting lives in the gateway
+(it knows why it asked).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from ..utils.metrics import METRICS
+from ..utils.persist import load_json, save_json_atomic
+
+JobKey = Tuple[str, int, int]  # (data, lower, upper) — the job signature
+
+
+class ResultCache:
+    """LRU of job signature -> ``(hash, nonce)``.  ``capacity=0`` disables
+    storage (every ``get`` misses); ``path`` arms write-through persistence.
+    Not thread-safe by itself — the gateway serializes access under the
+    server shell's event lock, like every other policy structure."""
+
+    def __init__(self, capacity: int = 1024, path: Optional[str] = None) -> None:
+        self.capacity = max(0, int(capacity))
+        self.path = path
+        self._entries: "OrderedDict[JobKey, Tuple[int, int]]" = OrderedDict()
+        self._dirty = False
+        if path is not None:
+            self._load(path)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key: JobKey) -> Optional[Tuple[int, int]]:
+        hit = self._entries.get(key)
+        if hit is not None:
+            self._entries.move_to_end(key)  # LRU freshness
+        return hit
+
+    def put(self, key: JobKey, hash_: int, nonce: int) -> None:
+        if self.capacity == 0:
+            return
+        self._entries[key] = (hash_, nonce)
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+            METRICS.inc("gateway.cache_evictions")
+        self._dirty = True
+
+    # ------------------------------------------------------------ persistence
+
+    def _serialize(self) -> dict:
+        return {
+            "version": 1,
+            # LRU order (oldest first) so a reload evicts the same way.
+            "entries": [
+                [k[0], k[1], k[2], h, n]
+                for k, (h, n) in self._entries.items()
+            ],
+        }
+
+    def flush(self) -> Optional[dict]:
+        """The serializable state if dirty (clears the flag), else None.
+        The shell snapshots this under its event lock and hands the dict
+        to ``save_json_atomic`` outside it — write amortized to its tick,
+        never on the per-job hot path.  If that write FAILS, the shell
+        must call :meth:`mark_dirty` so the next tick retries (the same
+        only-advance-on-success contract as the checkpoint's saved_rev)."""
+        if not self._dirty:
+            return None
+        self._dirty = False
+        return self._serialize()
+
+    def mark_dirty(self) -> None:
+        self._dirty = True
+
+    def save(self, path: str) -> None:
+        self._dirty = False
+        save_json_atomic(path, self._serialize())
+
+    def _load(self, path: str) -> None:
+        state = load_json(path)
+        if state is None:
+            return  # missing/torn file: start empty (same as checkpoint)
+        for entry in state.get("entries", ()):
+            try:
+                data, lower, upper, h, n = entry
+            except (TypeError, ValueError):
+                continue  # one bad row must not poison the rest
+            if not (isinstance(data, str) and all(
+                isinstance(v, int) and not isinstance(v, bool)
+                for v in (lower, upper, h, n)
+            )):
+                continue
+            self._entries[(data, lower, upper)] = (h, n)
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)

@@ -1,0 +1,346 @@
+"""The serving gateway: admission control + coalescing + result cache.
+
+Sits between the LSP server loop and the :class:`Scheduler` and speaks the
+scheduler's exact event interface (``miner_joined`` / ``client_request`` /
+``result`` / ``lost`` / ``tick`` / ``checkpoint`` / ``stats`` /
+``drain_evictions`` / ``revision``), so ``apps.server.serve`` runs either
+one unchanged — the gateway is a drop-in decorator of the scheduler, and
+like it is pure event-driven policy: ids + ``now`` in, ``(conn_id,
+Message)`` actions out, no sockets, no clocks, no threads.
+
+What it adds, in the order a request meets it:
+
+1. **Content-addressed result cache** (:class:`ResultCache`): the argmin
+   over ``(data, lower, upper)`` is pure, so a solved signature answers in
+   one round-trip with zero device work (``gateway.cache_hits``).
+2. **Request coalescing**: concurrent Requests with the same signature
+   share ONE underlying sweep.  The gateway submits each distinct
+   signature to the scheduler under a *virtual* client id (negative, so it
+   can never collide with a real LSP conn id) and keeps the waiter list;
+   the single Result fans out to every waiting conn (``gateway.coalesced``).
+   A waiter dying just leaves the list; only when the LAST waiter is gone
+   does the underlying job get cancelled — through ``Scheduler.lost``, so
+   partial progress lands in the existing checkpoint-identity orphan stash
+   and a later resubmission *resumes* rather than restarts.
+3. **Admission control**: at most ``max_active`` signatures run
+   concurrently, and each client key has a token bucket (``rate``/
+   ``burst``).  Over-limit requests queue in a weighted fair queue
+   (backpressure: ``gateway.throttled``) instead of dispatching; when the
+   global backlog exceeds ``max_queued``, the request is shed and the shell
+   closes the conn exactly like a dead client (``gateway.shed``, via
+   ``drain_evictions``).  Admitted jobs carry their client key into the
+   scheduler's tenant WFQ, so one client flooding distinct signatures
+   cannot starve another tenant's nonce throughput either.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..apps.scheduler import Action, JobKey, Scheduler
+from ..bitcoin.message import Message, MsgType
+from ..utils.metrics import METRICS
+from .admission import FairQueue, TokenBucket
+from .cache import ResultCache
+
+
+@dataclass
+class _Inflight:
+    """One signature's shared sweep: the virtual id the scheduler knows it
+    by, plus every real conn waiting on the answer (arrival order)."""
+
+    vid: int
+    key: JobKey
+    client_key: str
+    waiters: List[int] = field(default_factory=list)
+
+
+#: A request parked in the admission queue: (conn_id, signature, client key).
+_Queued = Tuple[int, JobKey, str]
+
+
+class Gateway:
+    """Event-in, actions-out serving layer (see module docstring)."""
+
+    def __init__(
+        self,
+        scheduler: Optional[Scheduler] = None,
+        *,
+        cache: Optional[ResultCache] = None,
+        rate: Optional[float] = 5.0,
+        burst: float = 10.0,
+        max_active: int = 64,
+        max_queued: int = 256,
+        max_buckets: int = 4096,
+    ) -> None:
+        self.sched = scheduler if scheduler is not None else Scheduler()
+        self.cache = cache if cache is not None else ResultCache()
+        self.rate = rate  # per-client requests/sec; None = unlimited
+        self.burst = burst
+        self.max_active = max(1, max_active)
+        self.max_queued = max(0, max_queued)
+        self.max_buckets = max(1, max_buckets)
+        self._by_key: Dict[JobKey, _Inflight] = {}
+        self._by_vid: Dict[int, _Inflight] = {}
+        self._conn_key: Dict[int, JobKey] = {}  # waiting conn -> signature
+        self._queued_conns: set = set()
+        self._queue = FairQueue()
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._shed: List[int] = []
+        self._next_vid = -1  # virtual ids count down; real conn ids are > 0
+
+    # ------------------------------------------------------------------ events
+
+    def miner_joined(self, conn_id: int, now: float = 0.0) -> List[Action]:
+        if conn_id in self._conn_key or conn_id in self._queued_conns:
+            # Request-then-Join role confusion: the scheduler's own guard
+            # (conn in jobs) cannot see it — the job runs under a virtual
+            # id — and accepting would leave a phantom miner behind when
+            # Gateway.lost later takes the waiter branch.  Refuse, exactly
+            # as the scheduler refuses Join-after-Request without a gateway.
+            return []
+        return self._translate(self.sched.miner_joined(conn_id, now), now)
+
+    def result(
+        self, conn_id: int, hash_: int, nonce: int, now: float = 0.0
+    ) -> List[Action]:
+        out = self._translate(self.sched.result(conn_id, hash_, nonce, now), now)
+        out.extend(self._admit(now))  # a completion may have freed capacity
+        return out
+
+    def tick(self, now: float) -> List[Action]:
+        out = self._translate(self.sched.tick(now), now)
+        out.extend(self._admit(now))  # token buckets refill with time
+        return out
+
+    def client_request(
+        self,
+        conn_id: int,
+        data: str,
+        lower: int,
+        upper: int,
+        now: float = 0.0,
+        client_key: Optional[str] = None,
+    ) -> List[Action]:
+        """``client_key`` is the admission/fairness principal — the shell
+        passes a stable per-client identity (endpoint label, remote addr);
+        default is the conn itself."""
+        if (
+            conn_id in self._conn_key
+            or conn_id in self._queued_conns
+            or conn_id in self.sched.miners
+        ):
+            return []  # one job per conn; miner/role confusion: ignore
+        if lower < 0 or upper >= 1 << 64:
+            # Mirror the scheduler's guard BEFORE creating gateway state: a
+            # poison request must not leave a never-completing inflight.
+            return []
+        key: JobKey = (data, lower, upper)
+        ckey = client_key or f"conn:{conn_id}"
+        METRICS.inc("gateway.requests")
+        # 1. Solved before: answer from the cache, zero scheduler work.
+        hit = self.cache.get(key)
+        if hit is not None:
+            METRICS.inc("gateway.cache_hits")
+            return [(conn_id, Message.result(hit[0], hit[1]))]
+        # 2. Already sweeping: join the waiter list, share the one sweep.
+        flight = self._by_key.get(key)
+        if flight is not None:
+            METRICS.inc("gateway.coalesced")
+            flight.waiters.append(conn_id)
+            self._conn_key[conn_id] = key
+            return []
+        # 3. Fresh signature: admit, queue, or shed.
+        if len(self._by_key) >= self.max_active or not self._take_token(ckey, now):
+            if len(self._queue) >= self.max_queued:
+                # Overflow: make the over-represented key pay, not the
+                # arrival — shedding the newcomer would let one flooder
+                # filling the queue get QUIET clients' conns closed.  Only
+                # when no key is over-represented (or the queue is
+                # disabled) does the arrival itself get shed.
+                victim = self._queue.shed_from_largest()
+                METRICS.inc("gateway.shed")
+                if victim is None:
+                    self._shed.append(conn_id)
+                    return []
+                self._queued_conns.discard(victim[0])
+                self._shed.append(victim[0])
+            METRICS.inc("gateway.throttled")
+            self._queue.push(ckey, (conn_id, key, ckey))
+            self._queued_conns.add(conn_id)
+            return []
+        return self._submit(conn_id, key, ckey, now)
+
+    def lost(self, conn_id: int, now: float = 0.0) -> List[Action]:
+        key = self._conn_key.pop(conn_id, None)
+        if key is not None:
+            flight = self._by_key.get(key)
+            if flight is not None and conn_id in flight.waiters:
+                flight.waiters.remove(conn_id)
+                if not flight.waiters:
+                    # Last waiter gone: cancel the shared sweep.  Through
+                    # Scheduler.lost, so partial progress is stashed under
+                    # the signature and a resubmission resumes it.
+                    del self._by_key[flight.key]
+                    del self._by_vid[flight.vid]
+                    out = self._translate(self.sched.lost(flight.vid, now), now)
+                    out.extend(self._admit(now))
+                    return out
+            return []
+        if conn_id in self._queued_conns:
+            self._queued_conns.discard(conn_id)
+            self._queue.remove_where(lambda item: item[0] == conn_id)
+            return []
+        # A miner (or a conn we never admitted): the scheduler sorts it out.
+        out = self._translate(self.sched.lost(conn_id, now), now)
+        out.extend(self._admit(now))
+        return out
+
+    # ------------------------------------------------------------ pass-through
+
+    @property
+    def revision(self) -> int:
+        return self.sched.revision
+
+    def checkpoint(self) -> dict:
+        return self.sched.checkpoint()
+
+    def load_checkpoint(self, state: dict) -> None:
+        self.sched.load_checkpoint(state)
+
+    def drain_evictions(self) -> List[int]:
+        """Evicted miners (scheduler) plus shed clients (admission): every
+        conn the transport shell should close."""
+        out = self.sched.drain_evictions()
+        out += self._shed
+        self._shed = []
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        st = self.sched.stats()
+        st.update(
+            gw_inflight=len(self._by_key),
+            gw_waiters=len(self._conn_key),
+            gw_queued=len(self._queue),
+            gw_cached=len(self.cache),
+        )
+        return st
+
+    # ------------------------------------------------------------------ internals
+
+    def _take_token(self, client_key: str, now: float) -> bool:
+        if self.rate is None:
+            return True
+        bucket = self._buckets.get(client_key)
+        if bucket is None:
+            if len(self._buckets) >= self.max_buckets:
+                # Bounded per-client state (with per-conn default keys
+                # every conn would otherwise leak a bucket for the server's
+                # lifetime).  Prefer dropping refilled-to-burst buckets — a
+                # full bucket is behaviorally identical to a fresh one — but
+                # the cap is HARD: if everyone is mid-drain, evict oldest
+                # (the worst that costs a flooder is a fresh burst allowance).
+                self._buckets = {
+                    k: b for k, b in self._buckets.items()
+                    if not b.is_full(now)
+                }
+                while len(self._buckets) >= self.max_buckets:
+                    self._buckets.pop(next(iter(self._buckets)))
+            bucket = self._buckets[client_key] = TokenBucket(
+                self.rate, self.burst, now
+            )
+        return bucket.try_take(now)
+
+    def _submit(
+        self, conn_id: int, key: JobKey, client_key: str, now: float
+    ) -> List[Action]:
+        """Dispatch a fresh signature into the scheduler under a virtual id
+        (tenant = the client key, so the scheduler's WFQ shares nonce
+        throughput per client, not per job)."""
+        vid = self._next_vid
+        self._next_vid -= 1
+        flight = _Inflight(vid=vid, key=key, client_key=client_key,
+                           waiters=[conn_id])
+        self._by_key[key] = flight
+        self._by_vid[vid] = flight
+        self._conn_key[conn_id] = key
+        METRICS.inc("gateway.admitted")
+        data, lower, upper = key
+        return self._translate(
+            self.sched.client_request(
+                vid, data, lower, upper, now, tenant=client_key
+            ),
+            now,
+        )
+
+    def _translate(self, actions: List[Action], now: float) -> List[Action]:
+        """Rewrite scheduler actions for the wire: a Result addressed to a
+        virtual id becomes a fan-out to every waiting conn (and lands in
+        the cache); everything else (chunk Requests to miners) passes
+        through untouched."""
+        out: List[Action] = []
+        for cid, msg in actions:
+            flight = self._by_vid.get(cid)
+            if flight is None or msg.type != MsgType.RESULT:
+                out.append((cid, msg))
+                continue
+            del self._by_vid[flight.vid]
+            del self._by_key[flight.key]
+            self.cache.put(flight.key, msg.hash, msg.nonce)
+            METRICS.inc("gateway.completed")
+            for waiter in flight.waiters:
+                self._conn_key.pop(waiter, None)
+                out.append((waiter, msg))
+            if len(flight.waiters) > 1:
+                METRICS.inc("gateway.fanout", len(flight.waiters) - 1)
+        return out
+
+    def _admit(self, now: float) -> List[Action]:
+        """Drain the backlog into freed capacity: coalesce/cache-check each
+        queued request again (its signature may have started or finished
+        while it waited), then dispatch if its bucket has a token.  Requests
+        still lacking tokens go back in the queue for a later event/tick."""
+        if not len(self._queue):
+            return []
+        out: List[Action] = []
+        deferred: List[Tuple[str, _Queued]] = []
+        while len(self._by_key) < self.max_active and len(self._queue):
+            popped = self._queue.pop()
+            if popped is None:
+                break
+            ckey, item = popped
+            conn_id, key, _ = item
+            if self._resolve_twin(item, out):
+                continue  # solved or started while it queued
+            if not self._take_token(ckey, now):
+                deferred.append((ckey, item))
+                continue
+            self._queued_conns.discard(conn_id)
+            out.extend(self._submit(conn_id, key, ckey, now))
+        for ckey, item in deferred:
+            self._queue.push(ckey, item)
+        # Even with every slot full, queued twins of an in-flight or solved
+        # signature need no slot of their own — resolve them now instead of
+        # leaving them parked a full completion cycle (the pred coalesces /
+        # answers as a side effect; True removes the item from the queue).
+        if len(self._queue):
+            self._queue.remove_where(lambda item: self._resolve_twin(item, out))
+        return out
+
+    def _resolve_twin(self, item: _Queued, out: List[Action]) -> bool:
+        conn_id, key, _ = item
+        hit = self.cache.get(key)
+        if hit is not None:
+            self._queued_conns.discard(conn_id)
+            METRICS.inc("gateway.cache_hits")
+            out.append((conn_id, Message.result(hit[0], hit[1])))
+            return True
+        flight = self._by_key.get(key)
+        if flight is not None:
+            self._queued_conns.discard(conn_id)
+            METRICS.inc("gateway.coalesced")
+            flight.waiters.append(conn_id)
+            self._conn_key[conn_id] = key
+            return True
+        return False

@@ -189,6 +189,7 @@ class NetSim:
         self._trace: Optional[List[Tuple]] = None
         self._schedule: List[Tuple[float, Tuple[Callable, ...]]] = []
         self._sched_idx = 0
+        self._loop_every: Optional[float] = None
         self._t0 = 0.0
         self._clock: Callable[[], float] = time.monotonic
         self._enabled = False
@@ -215,6 +216,7 @@ class NetSim:
             self._trace = None
             self._schedule = []
             self._sched_idx = 0
+            self._loop_every = None
             self._enabled = False
 
     def record_trace(self, enable: bool = True) -> None:
@@ -294,12 +296,25 @@ class NetSim:
 
     # -------------------------------------------------------------- schedule
 
-    def run(self, schedule: Schedule, clock: Callable[[], float] = time.monotonic) -> None:
+    def run(
+        self,
+        schedule: Schedule,
+        clock: Callable[[], float] = time.monotonic,
+        loop_every: Optional[float] = None,
+    ) -> None:
         """Arm a scenario: steps apply lazily as packet events observe the
-        clock passing their times (steps at t<=0 apply immediately)."""
+        clock passing their times (steps at t<=0 apply immediately).
+
+        ``loop_every=N`` replays the scenario every N seconds instead of
+        disarming after the last step — sustained chaos for long runs
+        (tools/fleet_bench.py --chaos), still fully deterministic: the
+        per-link RNG streams keep advancing across wraps."""
+        if loop_every is not None and loop_every <= 0:
+            raise ValueError(f"loop_every must be positive, got {loop_every}")
         with self._lock:
             self._schedule = schedule.sorted_steps()
             self._sched_idx = 0
+            self._loop_every = loop_every
             self._clock = clock
             self._t0 = clock()
             self._enabled = True
@@ -315,12 +330,21 @@ class NetSim:
             while True:
                 with self._lock:
                     if self._sched_idx >= len(self._schedule):
-                        if self._schedule:
-                            # Scenario over: drop it so a fully-healed
-                            # network re-disarms the per-packet fast path.
-                            self._schedule = []
+                        if not self._schedule:
+                            return
+                        if self._loop_every is not None:
+                            if now - self._t0 < self._loop_every:
+                                return  # wrap point not reached yet
+                            # Replay: shift the scenario origin one period
+                            # forward and fall through to re-apply steps.
+                            self._t0 += self._loop_every
                             self._sched_idx = 0
-                            self._refresh_enabled()
+                            continue
+                        # Scenario over: drop it so a fully-healed
+                        # network re-disarms the per-packet fast path.
+                        self._schedule = []
+                        self._sched_idx = 0
+                        self._refresh_enabled()
                         return
                     t, steps = self._schedule[self._sched_idx]
                     if now - self._t0 < t:

@@ -12,8 +12,8 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import defaultdict
-from typing import Dict
+from collections import defaultdict, deque
+from typing import Deque, Dict, Optional, Tuple
 
 
 class Metrics:
@@ -50,6 +50,16 @@ class Metrics:
 #:   sched.jobs_completed      Results sent back to clients
 #:   sched.jobs_resumed        jobs resumed from a checkpoint
 #:   sched.jobs_orphaned       dead clients' progress stashed for resubmit
+#:   sched.nonces_swept        nonces in accepted chunk Results (rate source)
+#:   gateway.requests          client Requests that reached the gateway
+#:   gateway.cache_hits        answered from the content-addressed cache
+#:   gateway.cache_evictions   cache entries dropped by the LRU bound
+#:   gateway.coalesced         Requests that joined an in-flight twin sweep
+#:   gateway.admitted          signatures dispatched into the scheduler
+#:   gateway.completed         shared sweeps finished (one per signature)
+#:   gateway.fanout            extra conns served by a coalesced Result
+#:   gateway.throttled         Requests queued by admission control
+#:   gateway.shed              Requests dropped on backlog overflow (conn closed)
 #:   miner.nonces              nonces swept by this process's miner loop
 #:   miner.reconnects          successful re-Joins after a lost server conn
 #:   miner.tier_downgrades     kernel tiers abandoned by the sweep watchdog
@@ -63,20 +73,65 @@ METRICS = Metrics()
 
 
 class RateMeter:
-    """Lifetime events/second since construction (e.g. a miner process's
-    average nonces/sec)."""
+    """Events/second — lifetime by default, recent with a ``window``.
 
-    def __init__(self, clock=time.monotonic) -> None:
+    The lifetime average (``window=None``, and always via :meth:`lifetime`)
+    is the bench-artifact number: total work over total wall time.  But on
+    a health line it goes stale — after a reconnect or a kernel-tier
+    downgrade the fleet's *current* rate can be far from the average since
+    process start — so ``window=N`` seconds makes :meth:`rate` a sliding-
+    window rate over the last N seconds of ``add``s instead (bucketed at
+    sub-window granularity, O(buckets) memory)."""
+
+    def __init__(
+        self, clock=time.monotonic, window: Optional[float] = None
+    ) -> None:
+        if window is not None and window <= 0:
+            raise ValueError(f"window must be positive, got {window}")
         self._clock = clock
+        self._window = window
         self._t0 = clock()
         self._n = 0
+        self._events: Deque[Tuple[float, int]] = deque()
         self._lock = threading.Lock()
 
     def add(self, n: int) -> None:
         with self._lock:
             self._n += n
+            if self._window is not None:
+                now = self._clock()
+                # Bucket adds landing close together so a hot loop cannot
+                # grow the deque unboundedly within one window.
+                grain = self._window / 64
+                if self._events and now - self._events[-1][0] < grain:
+                    t, old = self._events[-1]
+                    self._events[-1] = (t, old + n)
+                else:
+                    self._events.append((now, n))
+                self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self._window
+        while self._events and self._events[0][0] < horizon:
+            self._events.popleft()
 
     def rate(self) -> float:
+        """Recent events/sec over the window, or the lifetime average when
+        no window was configured."""
+        if self._window is None:
+            return self.lifetime()
+        with self._lock:
+            now = self._clock()
+            self._prune(now)
+            n = sum(c for _, c in self._events)
+            # Until a full window has elapsed, normalize by the elapsed
+            # time, not the window — a meter 2 s old with 100 events is
+            # doing 50/s, not 100/window.
+            dt = min(self._window, now - self._t0)
+            return n / dt if dt > 0 else 0.0
+
+    def lifetime(self) -> float:
+        """Lifetime events/second since construction (bench JSON number)."""
         with self._lock:
             dt = self._clock() - self._t0
             return self._n / dt if dt > 0 else 0.0

@@ -148,6 +148,42 @@ def test_schedule_steps_apply_in_time_order():
     assert sim.on_send(None, True)[0] is False  # healed again
 
 
+def test_schedule_loop_every_replays_scenario():
+    """``run(..., loop_every=N)`` re-arms the scenario every N seconds —
+    sustained chaos for long benches (tools/fleet_bench.py --chaos) —
+    instead of disarming after the last step."""
+    sim = NetSim()
+    sim.seed(1)
+    clock = [0.0]
+    sim.run(
+        Schedule().at(0.0, conditions(drop=100)).at(1.0, conditions()),
+        clock=lambda: clock[0],
+        loop_every=2.0,
+    )
+    assert sim.on_send(None, False)[0] is True  # loss phase, period 0
+    clock[0] = 1.5
+    assert sim.on_send(None, False)[0] is False  # healed window
+    clock[0] = 2.1
+    assert sim.on_send(None, False)[0] is True  # wrapped: loss phase again
+    clock[0] = 3.5
+    assert sim.on_send(None, False)[0] is False  # healed window, period 1
+    clock[0] = 8.1  # several periods later, mid-loss again
+    assert sim.on_send(None, False)[0] is True
+
+
+def test_schedule_without_loop_still_disarms():
+    sim = NetSim()
+    sim.seed(1)
+    clock = [0.0]
+    sim.run(
+        Schedule().at(0.0, conditions(drop=100)).at(1.0, conditions()),
+        clock=lambda: clock[0],
+    )
+    clock[0] = 5.0
+    assert sim.on_send(None, False)[0] is False
+    assert sim._enabled is False  # fast path re-disarmed
+
+
 def test_heal_does_not_pin_ambient_conditions():
     """Partitioning an endpoint while ambient loss is installed, then
     healing, must not leave the endpoint pinned to a stale copy of that

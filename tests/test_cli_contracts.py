@@ -48,6 +48,15 @@ class TestServerCLI:
         assert server_mod.main(["server", "notaport"]) == 0
         assert capsys.readouterr().out.startswith("Port must be a number:")
 
+    def test_bad_gateway_flag_reported_not_raised(self, capsys):
+        # Gateway admission knobs follow the --checkpoint idiom: a typoed
+        # value prints a line (same shape as the client's --retries) and
+        # exits cleanly before any socket is bound.
+        assert server_mod.main(["server", "6060", "--rate=abc"]) == 0
+        assert capsys.readouterr().out == "--rate=abc is not a number.\n"
+        assert server_mod.main(["server", "6060", "--max-queued=1.5"]) == 0
+        assert capsys.readouterr().out == "--max-queued=1.5 is not a number.\n"
+
 
 class TestMinerCLI:
     def test_usage_on_missing_hostport(self, capsys):

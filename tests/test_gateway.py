@@ -1,0 +1,637 @@
+"""The serving gateway: coalescing, result cache, admission control (ISSUE 3).
+
+Three layers of coverage, mirroring how the scheduler is tested:
+
+- pure-unit: the admission primitives (token bucket, fair queue) and the
+  result cache, including the torn-file persistence contract;
+- event-level: a Gateway over a real Scheduler driven by ids + ``now``,
+  no sockets — coalescing fan-out, cache-hit-zero-chunks, last-waiter
+  cancellation into the orphan stash, shedding, the throttle queue
+  draining as tokens refill, and the fair-queue delay bound for a client
+  competing with a rate-limited flood;
+- end-to-end: the gateway behind ``apps.server.serve`` over loopback LSP
+  with real miner threads, duplicate-heavy traffic bit-exact vs the
+  hashlib oracle and a repeat-submitted solved job answering with zero
+  chunks assigned (the ISSUE 3 acceptance shape).
+"""
+
+import threading
+import time
+
+import pytest
+
+from bitcoin_miner_tpu import lsp, lspnet
+from bitcoin_miner_tpu.apps import client as client_mod
+from bitcoin_miner_tpu.apps import miner as miner_mod
+from bitcoin_miner_tpu.apps import server as server_mod
+from bitcoin_miner_tpu.apps.scheduler import Scheduler
+from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+from bitcoin_miner_tpu.bitcoin.message import Message, MsgType
+from bitcoin_miner_tpu.gateway import FairQueue, Gateway, ResultCache, TokenBucket
+from bitcoin_miner_tpu.utils.metrics import METRICS
+
+pytestmark = pytest.mark.gateway
+
+DATA = "cmu440"
+
+
+def results(actions):
+    return [(cid, m) for cid, m in actions if m.type == MsgType.RESULT]
+
+
+def requests(actions):
+    return [(cid, m) for cid, m in actions if m.type == MsgType.REQUEST]
+
+
+# --------------------------------------------------------------- primitives
+
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        b = TokenBucket(rate=1.0, burst=2.0, now=0.0)
+        assert b.try_take(0.0) and b.try_take(0.0)  # the burst allowance
+        assert not b.try_take(0.0)  # empty
+        assert not b.try_take(0.5)  # half a token is not a token
+        assert b.try_take(1.0)  # one second -> one token
+        assert not b.try_take(1.0)
+
+    def test_refill_caps_at_burst(self):
+        b = TokenBucket(rate=100.0, burst=3.0, now=0.0)
+        for _ in range(3):
+            assert b.try_take(1000.0)
+        assert not b.try_take(1000.0)
+
+    def test_clock_never_runs_backward(self):
+        b = TokenBucket(rate=1.0, burst=1.0, now=10.0)
+        assert b.try_take(10.0)
+        b.try_take(5.0)  # stale now must not mint tokens or corrupt state
+        assert b.try_take(11.0)
+
+
+class TestFairQueue:
+    def test_fifo_within_one_key(self):
+        q = FairQueue()
+        q.push("a", (1,))
+        q.push("a", (2,))
+        assert q.pop() == ("a", (1,))
+        assert q.pop() == ("a", (2,))
+        assert q.pop() is None
+
+    def test_interleaves_a_flood_with_a_singleton(self):
+        q = FairQueue()
+        for i in range(10):
+            q.push("flood", (i,))
+        q.push("quiet", ("q",))
+        popped = [q.pop()[0] for _ in range(3)]
+        # The singleton must surface within the first two pops (its vt
+        # starts at the active minimum), not behind the whole flood.
+        assert "quiet" in popped[:2]
+
+    def test_weights_bias_the_share(self):
+        q = FairQueue()
+        for i in range(20):
+            q.push("heavy", (i,), weight=3.0)
+            q.push("light", (i,), weight=1.0)
+        first12 = [q.pop()[0] for _ in range(12)]
+        assert first12.count("heavy") >= 8  # ~3:1, not 1:1
+
+    def test_remove_where(self):
+        q = FairQueue()
+        q.push("a", (1, "x"))
+        q.push("a", (2, "y"))
+        q.push("b", (3, "x"))
+        assert q.remove_where(lambda item: item[1] == "x") == 2
+        assert len(q) == 1
+        assert q.pop() == ("a", (2, "y"))
+
+
+class TestResultCache:
+    def test_lru_eviction_and_counters(self):
+        METRICS.reset()
+        c = ResultCache(capacity=2)
+        c.put(("a", 0, 9), 1, 1)
+        c.put(("b", 0, 9), 2, 2)
+        c.get(("a", 0, 9))  # freshen a: b is now the LRU victim
+        c.put(("c", 0, 9), 3, 3)
+        assert c.get(("b", 0, 9)) is None
+        assert c.get(("a", 0, 9)) == (1, 1)
+        assert c.get(("c", 0, 9)) == (3, 3)
+        assert METRICS.get("gateway.cache_evictions") == 1
+
+    def test_capacity_zero_disables(self):
+        c = ResultCache(capacity=0)
+        c.put(("a", 0, 9), 1, 1)
+        assert c.get(("a", 0, 9)) is None
+        assert len(c) == 0
+
+    def test_persistence_roundtrip(self, tmp_path):
+        path = str(tmp_path / "cache.json")
+        c = ResultCache(capacity=8, path=path)
+        c.put((DATA, 0, 99), 555, 42)
+        c.put(("other", 5, 9), 7, 6)
+        c.save(path)
+        c2 = ResultCache(capacity=8, path=path)
+        assert c2.get((DATA, 0, 99)) == (555, 42)
+        assert c2.get(("other", 5, 9)) == (7, 6)
+
+    def test_flush_is_dirty_gated(self, tmp_path):
+        """Persistence rides the shell's tick: flush() hands back state
+        only when something changed since the last snapshot/save."""
+        c = ResultCache(capacity=8, path=str(tmp_path / "c.json"))
+        assert c.flush() is None  # clean at birth
+        c.put((DATA, 0, 99), 555, 42)
+        state = c.flush()
+        assert state is not None
+        assert state["entries"] == [[DATA, 0, 99, 555, 42]]
+        assert c.flush() is None  # flush cleared the flag
+        c.get((DATA, 0, 99))
+        assert c.flush() is None  # reads do not dirty
+
+    def test_torn_file_starts_empty(self, tmp_path):
+        path = tmp_path / "cache.json"
+        path.write_text('{"version": 1, "entries": [["a", 0')  # truncated
+        c = ResultCache(capacity=8, path=str(path))
+        assert len(c) == 0
+
+    def test_bad_rows_skipped_not_fatal(self, tmp_path):
+        path = tmp_path / "cache.json"
+        path.write_text(
+            '{"version": 1, "entries": [["good", 0, 9, 1, 2], '
+            '["short"], [3, 0, 9, 1, 2], ["bool", 0, 9, true, 2]]}'
+        )
+        c = ResultCache(capacity=8, path=str(path))
+        assert len(c) == 1
+        assert c.get(("good", 0, 9)) == (1, 2)
+
+
+# ------------------------------------------------------------- event-level
+
+
+def make_gateway(**kw):
+    kw.setdefault("rate", None)
+    sched_kw = kw.pop("sched", {})
+    sched_kw.setdefault("validate_results", False)
+    sched_kw.setdefault("min_chunk", 100)
+    return Gateway(Scheduler(**sched_kw), **kw)
+
+
+class TestCoalescing:
+    def test_twin_requests_share_one_sweep_and_fan_out(self):
+        METRICS.reset()
+        g = make_gateway()
+        g.miner_joined(1)
+        acts = g.client_request(10, DATA, 0, 99, now=0.0)
+        assert len(requests(acts)) == 1  # one chunk stream started
+        assert g.client_request(11, DATA, 0, 99, now=0.0) == []  # coalesced
+        assert g.client_request(12, DATA, 0, 99, now=0.0) == []
+        done = results(g.result(1, hash_=555, nonce=42, now=1.0))
+        assert sorted(cid for cid, _ in done) == [10, 11, 12]
+        assert all(m.hash == 555 and m.nonce == 42 for _, m in done)
+        assert METRICS.get("gateway.coalesced") == 2
+        assert METRICS.get("gateway.fanout") == 2
+        assert METRICS.get("sched.jobs_completed") == 1  # ONE sweep
+
+    def test_different_signatures_do_not_coalesce(self):
+        g = make_gateway()
+        g.miner_joined(1)
+        g.client_request(10, DATA, 0, 99, now=0.0)
+        acts = g.client_request(11, DATA, 0, 199, now=0.0)  # different range
+        assert g.stats()["gw_inflight"] == 2
+
+    def test_waiter_death_leaves_twin_running(self):
+        g = make_gateway()
+        g.miner_joined(1)
+        g.client_request(10, DATA, 0, 99, now=0.0)
+        g.client_request(11, DATA, 0, 99, now=0.0)
+        assert g.lost(10, now=0.5) == []  # first waiter dies
+        done = results(g.result(1, hash_=555, nonce=42, now=1.0))
+        assert [cid for cid, _ in done] == [11]  # survivor still answered
+
+    def test_last_waiter_death_cancels_and_stashes_progress(self):
+        METRICS.reset()
+        g = make_gateway(sched={"min_chunk": 100, "max_chunk": 100,
+                                "validate_results": False})
+        g.miner_joined(1, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        g.result(1, hash_=700, nonce=5, now=0.5)  # [0,99] swept
+        g.lost(10, now=1.0)  # last waiter gone -> job cancelled
+        assert g.stats()["gw_inflight"] == 0
+        assert METRICS.get("sched.jobs_orphaned") == 1
+        # A resubmission RESUMES the sweep instead of restarting it.
+        acts = g.client_request(20, DATA, 0, 299, now=2.0)
+        assert METRICS.get("sched.jobs_resumed") == 1
+        # The miner still holds the orphan's stale chunks (depth-2 queue),
+        # so nothing dispatches until those drain; the first fresh chunk
+        # handed out starts at 100 — [0,99] is never re-swept.
+        assert requests(acts) == []
+        acts = g.result(1, hash_=701, nonce=105, now=2.1)  # stale, jobless
+        req = requests(acts)
+        assert req and req[0][1].lower == 100
+
+    def test_repeat_submit_on_same_conn_ignored(self):
+        g = make_gateway()
+        g.miner_joined(1)
+        g.client_request(10, DATA, 0, 99, now=0.0)
+        assert g.client_request(10, DATA, 0, 199, now=0.0) == []
+        assert g.stats()["gw_inflight"] == 1
+
+    def test_poison_range_rejected_stateless(self):
+        g = make_gateway()
+        assert g.client_request(10, DATA, 5, 1 << 64, now=0.0) == []
+        assert g.stats()["gw_inflight"] == 0
+        assert g.stats()["gw_waiters"] == 0
+
+
+class TestCacheFront:
+    def test_solved_job_answers_with_zero_chunks(self):
+        METRICS.reset()
+        g = make_gateway()
+        g.miner_joined(1)
+        g.client_request(10, DATA, 0, 99, now=0.0)
+        g.result(1, hash_=555, nonce=42, now=1.0)
+        assigned = METRICS.get("sched.chunks_assigned")
+        acts = g.client_request(20, DATA, 0, 99, now=2.0)
+        assert results(acts) == [(20, acts[0][1])]
+        assert acts[0][1].hash == 555 and acts[0][1].nonce == 42
+        # The acceptance bar: the repeat assigned NO chunk at all.
+        assert METRICS.get("sched.chunks_assigned") == assigned
+        assert METRICS.get("gateway.cache_hits") == 1
+
+    def test_empty_range_result_is_cached_consistently(self):
+        g = make_gateway()
+        a1 = g.client_request(10, DATA, 5, 4, now=0.0)  # empty range
+        a2 = g.client_request(11, DATA, 5, 4, now=1.0)  # cache hit
+        assert results(a1)[0][1].hash == results(a2)[0][1].hash == 0
+
+    def test_checkpoint_passthrough_roundtrip(self):
+        g = make_gateway(sched={"min_chunk": 100, "max_chunk": 100,
+                                "validate_results": False})
+        g.miner_joined(1, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        g.result(1, hash_=700, nonce=5, now=0.5)
+        state = g.checkpoint()
+        [j] = state["jobs"]
+        assert j["best"] == [700, 5]
+        g2 = make_gateway()
+        g2.load_checkpoint(state)
+        assert g2.checkpoint()["jobs"] == state["jobs"]
+
+
+class TestAdmission:
+    def test_max_active_queues_then_admits_on_completion(self):
+        g = make_gateway(max_active=1)
+        g.miner_joined(1)
+        g.client_request(10, "a", 0, 99, now=0.0)
+        assert g.client_request(11, "b", 0, 99, now=0.0) == []  # queued
+        assert g.stats()["gw_queued"] == 1
+        acts = g.result(1, hash_=5, nonce=5, now=1.0)
+        # Completion of "a" both answers conn 10 and admits "b".
+        assert [cid for cid, _ in results(acts)] == [10]
+        assert requests(acts)  # "b"'s first chunk went out
+        assert g.stats()["gw_queued"] == 0
+        assert METRICS.get("gateway.throttled") >= 1
+
+    def test_queued_duplicate_coalesces_at_admit_time(self):
+        g = make_gateway(max_active=1)
+        g.miner_joined(1)
+        g.client_request(10, "a", 0, 99, now=0.0)
+        g.client_request(11, "b", 0, 99, now=0.0)  # queued
+        g.client_request(12, "b", 0, 99, now=0.0)  # queued twin of "b"
+        acts = g.result(1, hash_=5, nonce=5, now=1.0)  # frees a slot
+        # Both queued "b" requests ride ONE sweep.
+        assert g.stats()["gw_inflight"] == 1
+        assert g.stats()["gw_queued"] == 0
+        done = results(g.result(1, hash_=6, nonce=6, now=2.0))
+        assert sorted(cid for cid, _ in done) == [11, 12]
+
+    def test_completion_both_answers_and_admits_backlog(self):
+        g = make_gateway(max_active=1)
+        g.miner_joined(1)
+        g.client_request(10, "a", 0, 99, now=0.0)
+        g.client_request(11, "a", 0, 99, now=0.0)  # coalesces (in flight)
+        g.client_request(12, "b", 0, 99, now=0.0)  # queued
+        acts = g.result(1, hash_=5, nonce=5, now=1.0)
+        # "a" completed -> 10 and 11 answered; "b" admitted.
+        assert sorted(cid for cid, _ in results(acts)) == [10, 11]
+        done = results(g.result(1, hash_=6, nonce=6, now=2.0))
+        assert [cid for cid, _ in done] == [12]
+
+    def test_overflow_sheds_conn_via_evictions(self):
+        METRICS.reset()
+        g = make_gateway(max_active=1, max_queued=1)
+        g.miner_joined(1)
+        g.client_request(10, "a", 0, 99, now=0.0)
+        g.client_request(11, "b", 0, 99, now=0.0)  # fills the queue
+        assert g.client_request(12, "c", 0, 99, now=0.0) == []  # shed
+        assert g.drain_evictions() == [12]
+        assert g.drain_evictions() == []
+        assert METRICS.get("gateway.shed") == 1
+
+    def test_overflow_sheds_flood_tail_not_newcomer(self):
+        """When one client's backlog fills the queue, the overflow victim
+        is the FLOOD's newest request, not the quiet client arriving."""
+        METRICS.reset()
+        g = make_gateway(max_active=1, max_queued=3)
+        g.miner_joined(1)
+        g.client_request(10, "a", 0, 99, now=0.0, client_key="flood")
+        for i, conn in enumerate((11, 12, 13)):  # fill the queue as one key
+            g.client_request(conn, f"f{i}", 0, 99, now=0.0,
+                             client_key="flood")
+        assert g.stats()["gw_queued"] == 3
+        g.client_request(30, "quiet", 0, 99, now=0.0, client_key="quiet")
+        # The flood's newest parked request paid; the newcomer is queued.
+        assert g.drain_evictions() == [13]
+        assert g.stats()["gw_queued"] == 3
+        assert METRICS.get("gateway.shed") == 1
+
+    def test_request_then_join_refused(self):
+        """A conn holding a gateway-tracked Request cannot re-enroll as a
+        miner: under virtual ids the scheduler's own role guard is blind
+        to it, and accepting would leak a phantom miner on conn death."""
+        g = make_gateway()
+        g.miner_joined(1)
+        g.client_request(10, DATA, 0, 99, now=0.0)  # waiter
+        assert g.miner_joined(10) == []
+        assert 10 not in g.sched.miners
+        # Same for a conn parked in the admission queue.
+        g2 = make_gateway(max_active=1)
+        g2.client_request(20, "a", 0, 99, now=0.0)
+        g2.client_request(21, "b", 0, 99, now=0.0)  # queued
+        assert g2.miner_joined(21) == []
+        assert 21 not in g2.sched.miners
+
+    def test_queued_conn_death_forgotten(self):
+        g = make_gateway(max_active=1)
+        g.miner_joined(1)
+        g.client_request(10, "a", 0, 99, now=0.0)
+        g.client_request(11, "b", 0, 99, now=0.0)  # queued
+        g.lost(11, now=0.5)
+        acts = g.result(1, hash_=5, nonce=5, now=1.0)
+        # The dead conn's request must NOT be admitted.
+        assert g.stats()["gw_inflight"] == 0
+        assert g.stats()["gw_queued"] == 0
+
+    def test_token_bucket_throttles_then_tick_drains(self):
+        g = make_gateway(rate=1.0, burst=2.0)
+        g.miner_joined(1)
+        # One client key floods 4 distinct signatures at t=0.
+        for i, conn in enumerate((10, 11, 12, 13)):
+            g.client_request(conn, f"job{i}", 0, 99, now=0.0,
+                             client_key="flood")
+        assert g.stats()["gw_inflight"] == 2  # the burst allowance
+        assert g.stats()["gw_queued"] == 2  # the rest wait for tokens
+        assert g.tick(0.5) == []  # half a token: still parked
+        acts = g.tick(1.0)  # one token refilled
+        assert g.stats()["gw_inflight"] == 3
+        g.tick(2.0)
+        assert g.stats()["gw_inflight"] == 4
+        assert g.stats()["gw_queued"] == 0
+
+    def test_flood_does_not_delay_other_client_beyond_fair_bound(self):
+        """The ISSUE 3 acceptance property: with a rate-limited flood from
+        one client queued ahead of it, another client's single request is
+        admitted at the NEXT admission opportunity (fair-queue bound: one
+        pop), not behind the flood's whole backlog."""
+        g = make_gateway(rate=1.0, burst=1.0, max_active=1)
+        g.miner_joined(1)
+        g.client_request(10, "f0", 0, 99, now=0.0, client_key="flood")
+        for i, conn in enumerate(range(11, 19)):  # 8 more flood requests
+            g.client_request(conn, f"f{i + 1}", 0, 99, now=0.0,
+                             client_key="flood")
+        g.client_request(30, "quiet", 0, 99, now=0.1, client_key="quiet")
+        assert g.stats()["gw_queued"] == 9
+        # Completion 1 (t=5, tokens refilled for both keys): the freed slot
+        # goes to ONE more flood request — quiet activated at the same
+        # virtual time as the flood and the flood is older (FIFO tie).
+        g.result(1, hash_=5, nonce=5, now=5.0)
+        # Completion 2: the flood's virtual time now exceeds quiet's, so
+        # quiet is admitted next — one pop behind, NOT behind the 7 flood
+        # requests still parked.  That is the fair-queue bound.
+        g.result(1, hash_=6, nonce=6, now=6.0)
+        done = results(g.result(1, hash_=7, nonce=7, now=7.0))
+        assert [cid for cid, _ in done] == [30]
+        # Completion 3 also admitted flood #3: 6 flood requests still wait.
+        assert g.stats()["gw_queued"] == 6
+
+    def test_per_client_bucket_state_is_bounded(self):
+        """One bucket per client key must not leak for the server's
+        lifetime: refilled-to-burst buckets are pruned at the cap."""
+        g = make_gateway(rate=1000.0, burst=1.0, max_buckets=8,
+                         max_active=512)
+        g.miner_joined(1)
+        for i in range(100):
+            g.client_request(1000 + i, f"sig{i}", 0, 99, now=float(i),
+                             client_key=f"client{i}")
+        assert len(g._buckets) <= 9
+
+    def test_rate_none_never_throttles(self):
+        g = make_gateway(rate=None)
+        g.miner_joined(1)
+        for i in range(20):
+            g.client_request(100 + i, f"j{i}", 0, 99, now=0.0,
+                             client_key="one")
+        assert g.stats()["gw_inflight"] == 20
+        assert g.stats()["gw_queued"] == 0
+
+
+class TestSchedulerWFQ:
+    def test_flooding_tenant_gets_one_share(self):
+        """One tenant with 8 jobs vs one tenant with 1 job: chunk
+        assignments interleave ~1:1 per tenant, not 8:1 per job count."""
+        s = Scheduler(validate_results=False, min_chunk=100, max_chunk=100,
+                      pipeline_depth=1)
+        for i in range(8):
+            s.client_request(10 + i, f"flood{i}", 0, 10**6, tenant="F")
+        s.client_request(50, "quiet", 0, 10**6, tenant="Q")
+        s.miner_joined(1, now=0.0)
+        seq = []
+        for k in range(20):
+            acts = s.result(1, hash_=5, nonce=5, now=float(k + 1))
+            for _, m in requests(acts):
+                seq.append("Q" if m.data == "quiet" else "F")
+        # Equal weights, equal chunk sizes: Q holds ~half the assignments.
+        assert seq.count("Q") >= len(seq) // 2 - 1
+
+    def test_weight_skews_share(self):
+        s = Scheduler(validate_results=False, min_chunk=100, max_chunk=100,
+                      pipeline_depth=1)
+        s.client_request(10, "heavy", 0, 10**6, tenant="H", weight=3.0)
+        s.client_request(11, "light", 0, 10**6, tenant="L", weight=1.0)
+        s.miner_joined(1, now=0.0)
+        seq = []
+        for k in range(16):
+            acts = s.result(1, hash_=5, nonce=5, now=float(k + 1))
+            for _, m in requests(acts):
+                seq.append(m.data)
+        assert seq.count("heavy") >= 10  # ~3:1 of 16
+
+    def test_new_tenant_starts_at_active_floor(self):
+        """A tenant arriving late must neither starve incumbents (vt=0
+        debt) nor be starved (inherited charges)."""
+        s = Scheduler(validate_results=False, min_chunk=100, max_chunk=100,
+                      pipeline_depth=1)
+        s.client_request(10, "old", 0, 10**6, tenant="A")
+        s.miner_joined(1, now=0.0)
+        for k in range(10):  # A accrues virtual time
+            s.result(1, hash_=5, nonce=5, now=float(k + 1))
+        s.client_request(11, "new", 0, 10**6, tenant="B")
+        seq = []
+        for k in range(10):
+            acts = s.result(1, hash_=5, nonce=5, now=float(20 + k))
+            for _, m in requests(acts):
+                seq.append(m.data)
+        assert 4 <= seq.count("new") <= 6  # ~half, not all, not none
+
+    def test_tenant_cleanup_on_finish_and_loss(self):
+        s = Scheduler(validate_results=False, min_chunk=1000)
+        s.miner_joined(1)
+        s.client_request(10, "a", 0, 99, tenant="T")
+        s.client_request(11, "b", 0, 99, tenant="T")
+        assert s.stats()["tenants"] == 1
+        s.result(1, hash_=5, nonce=5)  # finishes "a"
+        assert s.stats()["tenants"] == 1  # "b" keeps T alive
+        s.lost(11)
+        assert s.stats()["tenants"] == 0
+
+
+# -------------------------------------------------------------- end-to-end
+
+PARAMS = lsp.Params(epoch_limit=5, epoch_millis=200, window_size=5)
+
+
+@pytest.fixture(autouse=True)
+def _clean_network():
+    lspnet.reset_faults()
+    yield
+    lspnet.reset_faults()
+
+
+class GatewayFleet:
+    """In-process cluster: gateway-fronted scheduler + miner threads."""
+
+    def __init__(self, n_miners=2, min_chunk=500, **gw_kwargs):
+        gw_kwargs.setdefault("rate", None)
+        self.server = lsp.Server(0, PARAMS)
+        self.scheduler = Scheduler(min_chunk=min_chunk)
+        self.gateway = Gateway(self.scheduler, **gw_kwargs)
+        threading.Thread(
+            target=server_mod.serve,
+            args=(self.server, self.gateway),
+            kwargs={"tick_interval": 0.05},
+            daemon=True,
+        ).start()
+        for _ in range(n_miners):
+            self.add_miner()
+
+    def add_miner(self, search=None):
+        c = lsp.Client("127.0.0.1", self.server.port, PARAMS)
+        threading.Thread(
+            target=miner_mod.run_miner,
+            args=(c, search or miner_mod.make_search("cpu")),
+            daemon=True,
+        ).start()
+        return c
+
+    def request(self, data, max_nonce):
+        c = lsp.Client("127.0.0.1", self.server.port, PARAMS)
+        try:
+            return client_mod.request_once(c, data, max_nonce)
+        finally:
+            c.close()
+
+    def close(self):
+        self.server.close()
+
+
+def test_gateway_fleet_duplicate_heavy_bit_exact():
+    """Six concurrent clients, two distinct signatures: every answer
+    bit-exact, at most two underlying sweeps, coalesce/cache hits > 0,
+    and a post-hoc repeat assigns zero chunks — the acceptance shape at
+    test scale (tools/loadgen.py runs it at 8 clients / 50% dups)."""
+    METRICS.reset()
+    fleet = GatewayFleet(n_miners=2)
+    sigs = [("gwalpha", 3000), ("gwbeta", 4000)]
+    expected = {d: min_hash_range(d, 0, mx) for d, mx in sigs}
+    out = {}
+
+    def one(i):
+        d, mx = sigs[i % 2]
+        out[i] = (d, fleet.request(d, mx))
+
+    try:
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "client starved"
+        for i, (d, got) in out.items():
+            assert got == expected[d], f"client {i}"
+        assert METRICS.get("gateway.requests") == 6
+        assert METRICS.get("gateway.completed") <= 2  # <= one sweep per sig
+        assert (
+            METRICS.get("gateway.coalesced") + METRICS.get("gateway.cache_hits")
+            == 4
+        )
+        # Repeat-submitted solved job: zero chunks assigned.
+        assigned = METRICS.get("sched.chunks_assigned")
+        d, mx = sigs[0]
+        assert fleet.request(d, mx) == expected[d]
+        assert METRICS.get("sched.chunks_assigned") == assigned
+    finally:
+        fleet.close()
+
+
+def test_gateway_fleet_shed_conn_sees_disconnected():
+    """A shed request's conn is closed exactly like a dead client: the
+    waiting client unblocks with None (the Disconnected contract)."""
+    hold = threading.Event()
+    fleet = GatewayFleet(
+        n_miners=0, max_active=1, max_queued=0,
+    )
+    try:
+        fleet.add_miner(lambda d, lo, hi: (hold.wait(30), min_hash_range(d, lo, hi))[1])
+        box = {}
+
+        def first():
+            box["a"] = fleet.request("gwheld", 2000)
+
+        ta = threading.Thread(target=first, daemon=True)
+        ta.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not fleet.gateway.stats()["gw_inflight"]:
+            time.sleep(0.05)
+        assert fleet.gateway.stats()["gw_inflight"] == 1
+        # Queue is size 0: the next distinct signature is shed.
+        assert fleet.request("gwshed", 2000) is None
+        hold.set()
+        ta.join(timeout=30)
+        assert box["a"] == min_hash_range("gwheld", 0, 2000)
+    finally:
+        hold.set()
+        fleet.close()
+
+
+def test_gateway_cache_persists_across_fleet_restart(tmp_path):
+    """Fleet 1 solves a job; fleet 2 (fresh server+scheduler, same cache
+    file) answers the repeat with no miners at all."""
+    path = str(tmp_path / "results.json")
+    fleet = GatewayFleet(n_miners=1, cache=ResultCache(path=path))
+    want = min_hash_range("gwpersist", 0, 2500)
+    try:
+        assert fleet.request("gwpersist", 2500) == want
+        # Persistence rides the serve ticker (50 ms here): wait for the
+        # flush to land before killing the fleet, or the restart below
+        # would block forever on a miner-less server.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not len(ResultCache(path=path)):
+            time.sleep(0.05)
+        assert len(ResultCache(path=path)) == 1, "cache flush never landed"
+    finally:
+        fleet.close()
+    # Miner-less restart: only the cache can answer — and it does.
+    fleet2 = GatewayFleet(n_miners=0, cache=ResultCache(path=path))
+    try:
+        assert fleet2.request("gwpersist", 2500) == want
+    finally:
+        fleet2.close()

@@ -1,0 +1,54 @@
+"""tools/loadgen.py in tier-1: the serving-layer acceptance run at --fast
+scale (ISSUE 3) — 8 clients, 50% duplicate signatures, every Result
+bit-exact vs the hashlib oracle (the tool raises otherwise), coalesce/
+cache hits visible in the gateway counters, and a repeat-submitted solved
+job completing with zero chunks assigned."""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.gateway
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location(
+        "loadgen", REPO / "tools" / "loadgen.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_loadgen_fast_duplicate_heavy(capsys):
+    loadgen = _load_tool()
+    rc = loadgen.main(["--fast", "--clients", "8", "--dup", "0.5"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "loadgen_jobs_per_sec"
+    assert out["value"] > 0
+    assert out["clients"] == 8 and out["dup_fraction"] == 0.5
+    assert out["distinct_signatures"] < out["jobs"]  # dups really happened
+    # The acceptance counters: duplicates were deduplicated, not re-swept.
+    gw = out["gateway_counters"]
+    hits = gw.get("gateway.coalesced", 0) + gw.get("gateway.cache_hits", 0)
+    # Every duplicate deduplicated (+1: the repeat probe is a cache hit).
+    assert hits == out["jobs"] - out["distinct_signatures"] + 1
+    assert gw.get("gateway.completed", 0) <= out["distinct_signatures"]
+    # Repeat-submitted solved job: answered with ZERO chunks assigned.
+    assert out["repeat_zero_chunks"] is True
+    # The baseline leg re-swept duplicates; the gateway leg did not.
+    assert out["swept_nonces"] <= out["baseline_swept_nonces"]
+
+
+def test_loadgen_workload_is_seeded():
+    loadgen = _load_tool()
+
+    class A:
+        jobs, dup, max_nonce, seed = 30, 0.5, 10_000, 11
+
+    assert loadgen.build_workload(A) == loadgen.build_workload(A)

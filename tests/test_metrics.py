@@ -2,6 +2,8 @@
 
 import time
 
+import pytest
+
 from bitcoin_miner_tpu import lsp, lspnet
 from bitcoin_miner_tpu.apps.scheduler import Scheduler
 from bitcoin_miner_tpu.utils.metrics import METRICS, Metrics, RateMeter
@@ -23,6 +25,41 @@ def test_rate_meter():
     r.add(100)
     t[0] = 2.0
     assert r.rate() == 50.0
+
+
+def test_rate_meter_sliding_window_forgets_stale_bursts():
+    """window=N: rate() is the RECENT rate — a burst older than the window
+    (pre-reconnect throughput, say) no longer props the number up."""
+    t = [0.0]
+    r = RateMeter(clock=lambda: t[0], window=10.0)
+    r.add(1000)  # ancient burst
+    t[0] = 100.0
+    r.add(50)
+    t[0] = 105.0
+    r.add(50)
+    # Window covers [95, 105]: only the two 50s count -> 100/10s.
+    assert r.rate() == pytest.approx(10.0)
+    # The lifetime average still sees everything (bench JSON number).
+    assert r.lifetime() == pytest.approx(1100 / 105.0)
+
+
+def test_rate_meter_window_normalizes_by_elapsed_at_startup():
+    # 2 s into a 10 s window, 100 events is 50/s, not 100/window.
+    t = [0.0]
+    r = RateMeter(clock=lambda: t[0], window=10.0)
+    t[0] = 2.0
+    r.add(100)
+    assert r.rate() == pytest.approx(50.0)
+
+
+def test_rate_meter_window_memory_is_bounded():
+    t = [5.0]
+    r = RateMeter(clock=lambda: t[0], window=10.0)
+    for i in range(100_000):  # a hot add loop inside one window
+        r.add(1)
+    assert len(r._events) <= 65  # bucketed, not one entry per add
+    t[0] = 10.0
+    assert r.rate() == pytest.approx(100_000 / 5.0)
 
 
 def test_scheduler_counters():

@@ -473,8 +473,15 @@ def test_server_logs_health(caplog):
                 assert client_mod.request_once(c, "health", 2000) == (
                     min_hash_range("health", 0, 2000)
                 )
+                # Wait for a health line that has SEEN the fleet: the first
+                # line can beat the miner's Join (ticker t=0.2 vs conn
+                # handshake), and repeats are deduped, so polling for the
+                # bare prefix races the join on a fast box.
                 deadline = time.monotonic() + 5.0
-                while time.monotonic() < deadline and "health {" not in caplog.text:
+                while (
+                    time.monotonic() < deadline
+                    and "'miners': 1" not in caplog.text
+                ):
                     time.sleep(0.1)
             finally:
                 c.close()

@@ -306,6 +306,74 @@ class TestCheckpointResume:
         s2 = Scheduler(resume_state=state)
         assert s2.checkpoint()["jobs"] == state["jobs"]
 
+    def test_two_resubmits_after_lost_first_resumes_second_restarts(self):
+        """The gateway cancels a coalesced job through ``lost()`` when its
+        last waiter dies; if TWO clients then resubmit the identical
+        signature, exactly one consumes the orphan stash (first come) and
+        the other starts full-range — never a double-consume, never a
+        lost best-so-far, and the checkpoint folds back to one entry."""
+        METRICS.reset()
+        s = Scheduler(min_chunk=100, max_chunk=100, validate_results=False)
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, DATA, 0, 299, now=0.0)
+        s.result(1, hash_=700, nonce=5, now=0.5)  # [0,99] swept
+        s.lost(10, now=1.0)
+        assert METRICS.get("sched.jobs_orphaned") == 1
+        s.client_request(20, DATA, 0, 299, now=2.0)
+        s.client_request(21, DATA, 0, 299, now=2.0)
+        assert METRICS.get("sched.jobs_resumed") == 1  # exactly one resume
+        resumed, fresh = s.jobs[20], s.jobs[21]
+        assert resumed.best == (700, 5)  # stashed progress carried over
+        assert fresh.best is None  # the twin starts from scratch...
+        remaining_fresh = list(fresh.pending) + [
+            iv for lst in fresh.outstanding.values() for iv in lst
+        ]
+        assert sorted(remaining_fresh)[0][0] == 0  # ...over the full range
+        # One merged checkpoint entry covers both, best preserved.
+        [j] = s.checkpoint()["jobs"]
+        assert j["best"] == [700, 5]
+        assert j["remaining"] == [[0, 299]]
+
+    def test_resume_entry_races_live_identical_twin(self):
+        """A staged checkpoint entry consumed by one request while an
+        identical twin runs concurrently (the shape behind a gateway
+        coalesce racing checkpoint-resume): the resumed job must keep the
+        stashed best and skip swept ranges, the twin must sweep the full
+        range, and both must answer bit-exact."""
+        staged_best = [hash_nonce(DATA, 150), 150]
+        state = {
+            "version": 1,
+            "jobs": [
+                {
+                    "data": DATA,
+                    "lower": 0,
+                    "upper": 199,
+                    "best": staged_best,
+                    "remaining": [[100, 199]],
+                }
+            ],
+        }
+        s = Scheduler(min_chunk=1000, resume_state=state)
+        s.miner_joined(1, now=0.0)
+        s.miner_joined(2, now=0.0)
+        s.client_request(10, DATA, 0, 199, now=0.0)  # consumes the stash
+        s.client_request(11, DATA, 0, 199, now=0.0)  # identical twin, fresh
+        # Miner 1 holds the resumed tail [100,199]; miner 2 the full range.
+        assert s.jobs[10].outstanding[1] == [(100, 199)]
+        assert s.jobs[11].outstanding[2] == [(0, 199)]
+        # Mid-flight, the merged checkpoint is ONE conservative entry.
+        [j] = s.checkpoint()["jobs"]
+        assert j["best"] == staged_best
+        assert j["remaining"] == [[0, 199]]
+        h1, n1 = honest(DATA, 100, 199)
+        final_a = results(s.result(1, h1, n1, now=1.0))
+        assert (final_a[0][1].hash, final_a[0][1].nonce) == min(
+            (tuple(staged_best)), (h1, n1)
+        )
+        h2, n2 = honest(DATA, 0, 199)
+        final_b = results(s.result(2, h2, n2, now=1.5))
+        assert (final_b[0][1].hash, final_b[0][1].nonce) == (h2, n2)
+
     def test_two_identical_concurrent_jobs_checkpoint_merges(self):
         """Two clients running the same (data, lower, upper) concurrently
         produce one merged checkpoint entry covering both jobs' unswept

@@ -239,6 +239,22 @@ def main() -> int:
         "real fleet",
     )
     ap.add_argument("--drill-nonces", type=int, default=6 * 10**9)
+    ap.add_argument(
+        "--chaos",
+        metavar="SCENARIO",
+        default=None,
+        help="apply this named seeded lspnet.chaos schedule in the SERVER "
+        "process for the whole run (looped every --chaos-loop seconds so "
+        "it stays active through the timed job) and report degraded-"
+        "network throughput; names: lspnet.standard_scenarios()",
+    )
+    ap.add_argument("--chaos-seed", type=int, default=1)
+    ap.add_argument(
+        "--chaos-loop",
+        type=float,
+        default=10.0,
+        help="replay period for the --chaos scenario (seconds)",
+    )
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument(
@@ -264,10 +280,32 @@ def main() -> int:
     client = None
     cpu_miners: list = []
     try:
+        server_env = {**os.environ, "PYTHONPATH": str(REPO)}
+        if args.chaos:
+            from bitcoin_miner_tpu.lspnet.chaos import standard_scenarios
+
+            # Validate HERE: the server subprocess's "unknown scenario"
+            # warning goes to a devnulled stderr, and a typoed name would
+            # otherwise stamp a chaos config onto a clean-network number.
+            if args.chaos not in standard_scenarios():
+                raise SystemExit(
+                    f"unknown --chaos scenario {args.chaos!r}; valid: "
+                    f"{sorted(standard_scenarios())}"
+                )
+            # The server arms the schedule at startup (apps/server.main);
+            # its tx shapes both the chunk stream to miners and the Result
+            # stream to clients — the degraded-network leg of the bench.
+            server_env.update(
+                BMT_CHAOS_SCENARIO=args.chaos,
+                BMT_CHAOS_LOOP=str(args.chaos_loop),
+                LSPNET_CHAOS_SEED=str(args.chaos_seed),
+            )
+            log(f"chaos: {args.chaos} (seed {args.chaos_seed}, "
+                f"looped every {args.chaos_loop:.1f}s) armed in the server")
         server = subprocess.Popen(
             [sys.executable, "-m", "bitcoin_miner_tpu.apps.server", str(port)],
             cwd=tmp,  # server writes ./log.txt (reference parity)
-            env={**os.environ, "PYTHONPATH": str(REPO)},
+            env=server_env,
             stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL,
             text=True,
@@ -407,6 +445,17 @@ def main() -> int:
                     "miner_restarts": keeper.restarts
                     - (drill["deliberate_kills"] if drill else 0),
                     "backend": args.backend,
+                    **(
+                        {
+                            "chaos": {
+                                "scenario": args.chaos,
+                                "seed": args.chaos_seed,
+                                "loop_s": args.chaos_loop,
+                            }
+                        }
+                        if args.chaos
+                        else {}
+                    ),
                     **(
                         {"cpu_miners": args.cpu_miners}
                         if args.cpu_miners

@@ -1,0 +1,270 @@
+"""Serving-layer load generator: duplicate-heavy traffic against the gateway.
+
+`fleet_bench.py` measures one big job's delivered nonces/s; this tool
+measures the SERVING layer — many small jobs from many concurrent clients,
+half of them duplicates, which is the regime the gateway exists for
+(ISSUE 3 / ROADMAP "millions of users"): coalescing folds concurrent
+twin sweeps into one, the content-addressed cache answers solved
+signatures with zero device work, and admission keeps the inflow bounded.
+
+The fleet is fully in-process (real loopback LSP: `apps.server.serve`
+thread + miner threads on the cpu tier + N client threads), so one run
+gives apples-to-apples legs:
+
+- **gateway leg** — `serve` runs a :class:`Gateway`-wrapped scheduler;
+- **baseline leg** (unless ``--no-baseline``) — the bare scheduler, where
+  every duplicate burns the fleet again.
+
+Every job's Result is validated bit-exact against the hashlib oracle
+(cached answers included — a wrong cache hit fails the run), and the
+gateway leg ends with a repeat-submission probe asserting a solved job
+answers with ZERO new chunks assigned.  Prints one JSON line; `--fast`
+keeps the whole thing under ~30 s on CPU so it gates tier-1
+(tests/test_loadgen.py).
+
+Usage: python tools/loadgen.py [--fast] [--clients N] [--jobs N]
+       [--dup F] [--max-nonce N] [--miners N] [--no-baseline] [--seed N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
+
+
+def log(*a) -> None:
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_workload(args) -> list:
+    """A duplicate-heavy job list: each entry is a ``(data, lower, upper)``
+    signature; with probability ``--dup`` a job repeats an earlier
+    signature — biased toward RECENT ones, so some duplicates land while
+    their twin is still sweeping (coalesce) and some after it solved
+    (cache hit)."""
+    rng = random.Random(args.seed)
+    issued: list = []
+    jobs: list = []
+    for i in range(args.jobs):
+        if issued and rng.random() < args.dup:
+            if rng.random() < 0.5:
+                sig = rng.choice(issued[-4:])  # recent: likely in flight
+            else:
+                sig = rng.choice(issued)  # any: likely already solved
+        else:
+            lo = 0
+            hi = rng.randint(args.max_nonce // 2, args.max_nonce)
+            sig = (f"load{len(issued)}", lo, hi)
+            issued.append(sig)
+        jobs.append(sig)
+    return jobs
+
+
+def run_leg(gateway_on: bool, jobs: list, args, oracle: dict) -> dict:
+    """Stand up one in-process fleet, push the whole workload through it
+    with ``--clients`` concurrent client threads, tear it down.  Returns
+    the leg's timing + METRICS deltas."""
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.apps import client as client_mod
+    from bitcoin_miner_tpu.apps import miner as miner_mod
+    from bitcoin_miner_tpu.apps import server as server_mod
+    from bitcoin_miner_tpu.apps.scheduler import Scheduler
+    from bitcoin_miner_tpu.gateway import Gateway, ResultCache
+    from bitcoin_miner_tpu.utils.metrics import METRICS
+
+    params = lsp.Params(epoch_limit=5, epoch_millis=200, window_size=5)
+    server = lsp.Server(0, params)
+    sched = Scheduler(min_chunk=args.min_chunk)
+    engine = (
+        Gateway(
+            sched,
+            cache=ResultCache(capacity=args.cache_size),
+            rate=None,  # per-conn buckets never bind over LSP; see README
+            max_active=args.max_active,
+        )
+        if gateway_on
+        else sched
+    )
+    threading.Thread(
+        target=server_mod.serve,
+        args=(server, engine),
+        kwargs={"tick_interval": 0.05},
+        daemon=True,
+    ).start()
+    search = miner_mod.make_search("cpu")
+    for _ in range(args.miners):
+        mc = lsp.Client("127.0.0.1", server.port, params)
+        threading.Thread(
+            target=miner_mod.run_miner, args=(mc, search), daemon=True
+        ).start()
+
+    before = METRICS.snapshot()
+    errors: list = []
+    cursor = [0]
+    cursor_lock = threading.Lock()
+
+    def worker(idx: int) -> None:
+        while True:
+            with cursor_lock:
+                if cursor[0] >= len(jobs):
+                    return
+                job_i = cursor[0]
+                cursor[0] += 1
+            data, lo, hi = jobs[job_i]
+            c = lsp.Client("127.0.0.1", server.port, params)
+            try:
+                got = client_mod.request_once(c, data, hi)
+            finally:
+                c.close()
+            want = oracle[(data, lo, hi)]
+            if got != want:
+                errors.append(
+                    f"job {job_i} ({data},{lo},{hi}): got {got}, want {want}"
+                )
+                return
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(args.clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=args.timeout)
+        if t.is_alive():
+            errors.append(f"worker timed out after {args.timeout:.0f}s")
+    wall = time.monotonic() - t0
+
+    repeat_zero_chunks = None
+    if gateway_on and not errors:
+        # Acceptance probe: a repeat of a SOLVED signature must answer
+        # from the cache with zero new chunks assigned.
+        assigned_before = METRICS.get("sched.chunks_assigned")
+        data, lo, hi = jobs[0]
+        c = lsp.Client("127.0.0.1", server.port, params)
+        try:
+            got = client_mod.request_once(c, data, hi)
+        finally:
+            c.close()
+        if got != oracle[(data, lo, hi)]:
+            errors.append(f"repeat probe wrong result: {got}")
+        repeat_zero_chunks = (
+            METRICS.get("sched.chunks_assigned") == assigned_before
+        )
+        if not repeat_zero_chunks:
+            errors.append("repeat probe assigned chunks (cache missed)")
+
+    server.close()
+    after = METRICS.snapshot()
+    deltas = {
+        k: after[k] - before.get(k, 0)
+        for k in sorted(after)
+        if k.startswith(("gateway.", "sched."))
+        and after[k] != before.get(k, 0)
+    }
+    if errors:
+        raise RuntimeError(
+            f"{'gateway' if gateway_on else 'baseline'} leg failed: "
+            + "; ".join(errors[:5])
+        )
+    return {
+        "wall_s": wall,
+        "jobs_per_sec": len(jobs) / wall if wall > 0 else 0.0,
+        "counters": deltas,
+        "repeat_zero_chunks": repeat_zero_chunks,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--jobs", type=int, default=64)
+    ap.add_argument("--dup", type=float, default=0.5,
+                    help="fraction of jobs repeating an earlier signature")
+    ap.add_argument("--max-nonce", type=int, default=60_000)
+    ap.add_argument("--miners", type=int, default=2)
+    ap.add_argument("--min-chunk", type=int, default=2000)
+    ap.add_argument("--cache-size", type=int, default=1024)
+    ap.add_argument("--max-active", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the bare-scheduler comparison leg")
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 preset: small jobs, done in well under 30 s")
+    args = ap.parse_args(argv)
+    if args.fast:
+        args.jobs = min(args.jobs, 24)
+        args.max_nonce = min(args.max_nonce, 4000)
+        args.timeout = min(args.timeout, 60.0)
+
+    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+
+    jobs = build_workload(args)
+    distinct = sorted(set(jobs))
+    log(f"workload: {len(jobs)} jobs, {len(distinct)} distinct signatures, "
+        f"{args.clients} clients, {args.miners} miners")
+    oracle = {sig: min_hash_range(sig[0], sig[1], sig[2]) for sig in distinct}
+
+    # Throwaway warm-up leg: pay the one-time costs (native backend build,
+    # transport/module init) so neither timed leg absorbs them.
+    run_leg(False, jobs[: min(4, len(jobs))], args, oracle)
+
+    gw = run_leg(True, jobs, args, oracle)
+    log(f"gateway leg: {gw['jobs_per_sec']:.2f} jobs/s over "
+        f"{gw['wall_s']:.2f}s; counters {gw['counters']}")
+    base = None
+    if not args.no_baseline:
+        base = run_leg(False, jobs, args, oracle)
+        log(f"baseline leg: {base['jobs_per_sec']:.2f} jobs/s over "
+            f"{base['wall_s']:.2f}s")
+
+    out = {
+        "metric": "loadgen_jobs_per_sec",
+        "value": round(gw["jobs_per_sec"], 3),
+        "unit": "jobs/s",
+        "clients": args.clients,
+        "jobs": len(jobs),
+        "distinct_signatures": len(distinct),
+        "dup_fraction": args.dup,
+        "max_nonce": args.max_nonce,
+        "miners": args.miners,
+        "seed": args.seed,
+        "fast": bool(args.fast),
+        "wall_s": round(gw["wall_s"], 3),
+        "repeat_zero_chunks": gw["repeat_zero_chunks"],
+        "gateway_counters": {
+            k: v for k, v in gw["counters"].items() if k.startswith("gateway.")
+        },
+        "swept_nonces": gw["counters"].get("sched.nonces_swept", 0),
+        **(
+            {
+                "baseline_jobs_per_sec": round(base["jobs_per_sec"], 3),
+                "baseline_wall_s": round(base["wall_s"], 3),
+                "baseline_swept_nonces": base["counters"].get(
+                    "sched.nonces_swept", 0
+                ),
+                "speedup_vs_baseline": round(
+                    gw["jobs_per_sec"] / base["jobs_per_sec"], 3
+                )
+                if base["jobs_per_sec"] > 0
+                else None,
+            }
+            if base is not None
+            else {}
+        ),
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
