@@ -9,7 +9,7 @@ connection is lost before the result arrives.
 from __future__ import annotations
 
 import sys
-from typing import Optional, TextIO, Tuple
+from typing import Callable, List, Optional, TextIO, Tuple
 
 from .. import lsp
 from ..bitcoin.message import Message, MsgType
@@ -43,7 +43,7 @@ def request_with_retry(
     params: Optional["lsp.Params"] = None,
     label: Optional[str] = None,
     first_client: Optional["lsp.Client"] = None,
-    sleep=None,
+    sleep: Optional[Callable[[float], None]] = None,
 ) -> Optional[Tuple[int, int]]:
     """Bounded retry-with-resubmit: one initial attempt plus up to
     ``retries`` resubmissions.  On a lost connection, reconnect (with
@@ -85,7 +85,7 @@ def request_with_retry(
     return None
 
 
-def main(argv=None, out: TextIO = sys.stdout) -> int:
+def main(argv: Optional[List[str]] = None, out: TextIO = sys.stdout) -> int:
     argv = sys.argv if argv is None else argv
     # Beyond-parity flag (same idiom as the server's --checkpoint=FILE):
     # --retries=N resubmits after a lost conn instead of printing

@@ -70,6 +70,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 from ..bitcoin.hash import hash_nonce
 from ..bitcoin.message import Message
 from ..utils.metrics import METRICS
+from ..utils.wfq import VirtualClockWFQ
 
 Action = Tuple[int, Message]  # (conn_id, message to send)
 Interval = Tuple[int, int]  # inclusive [lower, upper]
@@ -110,17 +111,6 @@ class _Miner:
     @property
     def timed_out(self) -> bool:
         return self.queue[0].timed_out if self.queue else False
-
-
-@dataclass
-class _Tenant:
-    """One fair-queue principal: the unit WFQ shares throughput across."""
-
-    key: str
-    weight: float = 1.0
-    vt: float = 0.0  # virtual time: sum of charged nonces / weight
-    seq: int = 0  # creation order (deterministic vt tie-break)
-    jobs: Deque[int] = field(default_factory=deque)  # client ids, RR order
 
 
 @dataclass
@@ -194,8 +184,9 @@ class Scheduler:
         self.orphan_cache_max = orphan_cache_max
         self.miners: Dict[int, _Miner] = {}
         self.jobs: Dict[int, _Job] = {}
-        self._tenants: Dict[str, _Tenant] = {}  # WFQ principals (see _next_job)
-        self._tenant_seq = 0
+        # WFQ principals (see _next_job): the shared virtual-clock
+        # primitive (utils/wfq.py), items = client conn ids in RR order.
+        self._tenants = VirtualClockWFQ()
         self._banned: set = set()  # evicted conn ids: Joins refused for good
         self._evicted: List[int] = []  # conns the shell should close
         #: Bumped by every state-mutating event; lets the server shell skip
@@ -520,30 +511,12 @@ class Scheduler:
         return max(self.min_chunk, min(size, self.max_chunk))
 
     def _tenant_add(self, key: str, conn_id: int, weight: float) -> None:
-        t = self._tenants.get(key)
-        if t is None:
-            # A newly active tenant starts at the minimum active virtual
-            # time: it cannot starve incumbents by arriving with vt=0 debt,
-            # and it does not inherit charges it never incurred.
-            floor = min(
-                (x.vt for x in self._tenants.values() if x.jobs), default=0.0
-            )
-            t = self._tenants[key] = _Tenant(
-                key=key, weight=max(weight, 1e-9), vt=floor,
-                seq=self._tenant_seq,
-            )
-            self._tenant_seq += 1
-        else:
-            t.weight = max(weight, 1e-9)  # latest submission's weight wins
-        t.jobs.append(conn_id)
+        # Floor init, weight update and tie-break seq all live in the
+        # shared primitive (utils/wfq.py) — the one copy of those rules.
+        self._tenants.add(key, conn_id, weight)
 
     def _tenant_remove(self, job: _Job) -> None:
-        t = self._tenants.get(job.tenant)
-        if t is not None:
-            if job.client_id in t.jobs:
-                t.jobs.remove(job.client_id)
-            if not t.jobs:
-                del self._tenants[t.key]
+        self._tenants.remove(job.tenant, job.client_id)
 
     def _next_job(self) -> Optional[_Job]:
         """Weighted fair queueing: among tenants with pending work, pick the
@@ -551,17 +524,14 @@ class Scheduler:
         then round-robin within that tenant's jobs.  ``_dispatch`` charges
         the tenant ``chunk_size / weight`` per carved chunk, so a tenant
         flooding many jobs gets one tenant's share, not N jobs' worth."""
-        best: Optional[_Tenant] = None
-        for t in self._tenants.values():
-            if best is not None and (t.vt, t.seq) >= (best.vt, best.seq):
-                continue
-            if any(self.jobs[cid].pending for cid in t.jobs):
-                best = t
+        best = self._tenants.select(
+            lambda p: any(self.jobs[cid].pending for cid in p.items)
+        )
         if best is None:
             return None
-        for _ in range(len(best.jobs)):
-            cid = best.jobs[0]
-            best.jobs.rotate(-1)
+        for _ in range(len(best.items)):
+            cid = best.items[0]
+            best.items.rotate(-1)
             job = self.jobs[cid]
             if job.pending:
                 return job
@@ -595,9 +565,8 @@ class Scheduler:
                 cut = min(hi, lo + size - 1)
                 if cut < hi:
                     job.pending.appendleft((cut + 1, hi))
-                t = self._tenants.get(job.tenant)
-                if t is not None:  # WFQ charge: carved nonces / weight
-                    t.vt += (cut - lo + 1) / t.weight
+                # WFQ charge: carved nonces, divided by weight inside.
+                self._tenants.charge(job.tenant, cut - lo + 1)
                 # A queued (not-yet-front) assignment starts its clock when
                 # it reaches the front (see result()); until then its
                 # started_at only matters if the queue is empty now.
@@ -629,7 +598,7 @@ class Scheduler:
             "miners": len(self.miners),
             "idle_miners": sum(1 for m in self.miners.values() if not m.queue),
             "jobs": len(self.jobs),
-            "tenants": len(self._tenants),
+            "tenants": self._tenants.key_count(),
             "pending_intervals": sum(len(j.pending) for j in self.jobs.values()),
             "outstanding_chunks": sum(
                 len(lst)

@@ -20,10 +20,11 @@ import os
 import sys
 import threading
 import time
-from typing import Optional
+from typing import Callable, List, Optional, Tuple
 
 from .. import lsp
 from ..bitcoin.message import Message, MsgType
+from ..utils import sanitize
 from ..utils.metrics import RateMeter
 from ..utils.persist import load_json, save_json_atomic
 from .scheduler import Scheduler
@@ -39,7 +40,7 @@ def serve(
     server: "lsp.Server",
     scheduler: Optional[Scheduler] = None,
     log: Optional[logging.Logger] = None,
-    clock=time.monotonic,
+    clock: Callable[[], float] = time.monotonic,
     tick_interval: float = 1.0,
     checkpoint_path: Optional[str] = None,
     health_interval: float = 10.0,
@@ -52,15 +53,22 @@ def serve(
     can't live on the read loop) and, if ``checkpoint_path`` is set,
     persists the scheduler's resumable progress there.
     """
-    sched = scheduler if scheduler is not None else Scheduler()
     log = log or logging.getLogger("bitcoin_miner_tpu.server")
-    lock = threading.Lock()  # serializes scheduler access with the ticker
+    # Serializes scheduler access with the ticker (tracked under
+    # BMT_SANITIZE=1, a plain threading.Lock otherwise).
+    lock = sanitize.make_lock("serve.event")
+    sched = scheduler if scheduler is not None else Scheduler()  # guarded-by: lock
     # A gateway-wrapped scheduler carries a result cache; its disk flushes
     # ride this ticker (snapshot under the lock, write outside) just like
     # the checkpoint — never on the per-job event path.
-    cache = getattr(sched, "cache", None)
-    if cache is not None and getattr(cache, "path", None) is None:
-        cache = None  # in-memory only: nothing to flush
+    cache = getattr(sched, "cache", None)  # guarded-by: lock; unguarded: setup, ticker not started
+    cache_path = getattr(cache, "path", None)  # unguarded: setup, and path is immutable
+    if cache_path is None:
+        cache = None  # in-memory only: nothing to flush  # unguarded: setup
+    # Race sanitizer (BMT_SANITIZE=1): every access to the policy objects
+    # off this lock raises once the ticker shares them (utils/sanitize.py).
+    sched = sanitize.guard(sched, lock, "scheduler")  # unguarded: setup
+    cache = sanitize.guard(cache, lock, "result-cache") if cache is not None else None  # unguarded: setup
     # Operator health surface (the reference's LOGF scaffold,
     # bitcoin/server/server.go:26-39, implies exactly this): periodic
     # scheduler stats + recovery counters in log.txt, so reassignment/
@@ -73,7 +81,7 @@ def serve(
     recent_nps = RateMeter(clock=clock, window=max(3 * health_interval, 10.0))
     swept_seen = [None]  # last sched.nonces_swept sample (None = first tick)
 
-    def health_line() -> str:
+    def health_line() -> str:  # guarded-by: lock (callers hold the event lock)
         from ..utils.metrics import METRICS
 
         counters = {
@@ -104,7 +112,7 @@ def serve(
         line = f"health {sched.stats()} {counters} nps={recent_nps.rate():.3g}"
         return f"{line} extra {extra}" if extra else line
 
-    def emit(actions) -> None:
+    def emit(actions: List[Tuple[int, Message]]) -> None:
         for conn_id, msg in actions:
             try:
                 server.write(conn_id, msg.marshal())
@@ -151,13 +159,14 @@ def serve(
                     saved_rev = rev
                 if cache_state is not None:
                     try:
-                        save_checkpoint(cache.path, cache_state)
+                        save_checkpoint(cache_path, cache_state)
                     except Exception:
                         # Re-arm so the NEXT tick retries even if no new
                         # result dirties the cache meanwhile (the
                         # checkpoint's only-advance-saved_rev-on-success
                         # contract, in dirty-flag form).
-                        cache.mark_dirty()
+                        with lock:
+                            cache.mark_dirty()
                         raise
             except Exception:
                 # A transient failure (e.g. checkpoint disk full) must not
@@ -211,18 +220,21 @@ def serve(
     finally:
         stop.set()
         tick_thread.join(timeout=2 * tick_interval + 1)
-        if cache is not None:
+        if cache is not None:  # unguarded: reads the binding, not the object
             # Final flush: a Result delivered just before shutdown must not
-            # miss the file because no tick fired after it.
-            cache_state = cache.flush()
+            # miss the file because no tick fired after it.  Still under
+            # the lock — the ticker join above can time out and leave it
+            # live (the lock-discipline checker flagged the bare access).
+            with lock:
+                cache_state = cache.flush()
             if cache_state is not None:
                 try:
-                    save_checkpoint(cache.path, cache_state)
+                    save_checkpoint(cache_path, cache_state)
                 except OSError:
                     log.exception("final result-cache flush failed")
 
 
-def main(argv=None) -> int:
+def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv if argv is None else argv
     # Parity: reference logs to ./log.txt (bitcoin/server/server.go:26-39).
     logging.basicConfig(

@@ -9,16 +9,17 @@ without clocks or sockets and stays deterministic under the chaos layer.
   dispatch" (backpressure), never "busy-wait".
 - :class:`FairQueue` — weighted fair queue of *queued requests* across
   client keys (request granularity; the scheduler's WFQ handles nonce
-  granularity once jobs are admitted).  Start-time virtual-clock WFQ, the
-  same scheme as ``Scheduler._next_job``: pop takes the lowest-virtual-time
-  key's oldest request and charges ``1 / weight``; a newly active key
-  starts at the minimum active virtual time.
+  granularity once jobs are admitted).  The virtual-clock discipline
+  itself (floor init, ``(vt, seq)`` tie-break, ``cost / weight`` charges)
+  lives in the shared :mod:`bitcoin_miner_tpu.utils.wfq` primitive — the
+  scheduler's tenant queue runs the same one, and ``tools/analyze``'s
+  ``wfq`` pass fails on any reimplementation — so this class is just the
+  request-shaped facade.
 """
 
 from __future__ import annotations
 
-from collections import deque
-from typing import Deque, Dict, Optional, Tuple
+from ..utils.wfq import VirtualClockWFQ
 
 
 class TokenBucket:
@@ -50,91 +51,13 @@ class TokenBucket:
         return self.tokens >= self.burst
 
 
-class _KeyQueue:
-    __slots__ = ("weight", "vt", "seq", "items")
-
-    def __init__(self, weight: float, vt: float, seq: int) -> None:
-        self.weight = weight
-        self.vt = vt
-        self.seq = seq
-        self.items: Deque[tuple] = deque()
-
-
-class FairQueue:
-    """Weighted fair queue of opaque items across client keys (see module
-    docstring).  Items are anything; the gateway queues pending-request
-    tuples.  ``__len__`` is the total backlog across every key."""
-
-    def __init__(self) -> None:
-        self._keys: Dict[str, _KeyQueue] = {}
-        self._seq = 0
-        self._len = 0
-
-    def __len__(self) -> int:
-        return self._len
+class FairQueue(VirtualClockWFQ):
+    """Weighted fair queue of queued requests across client keys (see
+    module docstring).  Items are anything; the gateway queues
+    pending-request tuples.  ``push``/``pop`` serve at unit cost — one
+    request, one charge — and ``__len__`` is the total backlog across
+    every key (the overflow bound).  Selection, floor init, tie-breaks,
+    and overflow victim choice are all the shared primitive's."""
 
     def push(self, key: str, item: tuple, weight: float = 1.0) -> None:
-        kq = self._keys.get(key)
-        if kq is None:
-            floor = min(
-                (k.vt for k in self._keys.values() if k.items), default=0.0
-            )
-            kq = self._keys[key] = _KeyQueue(max(weight, 1e-9), floor, self._seq)
-            self._seq += 1
-        else:
-            kq.weight = max(weight, 1e-9)
-        kq.items.append(item)
-        self._len += 1
-
-    def pop(self) -> Optional[Tuple[str, tuple]]:
-        best: Optional[_KeyQueue] = None
-        best_key = None
-        for key, kq in self._keys.items():
-            if kq.items and (
-                best is None or (kq.vt, kq.seq) < (best.vt, best.seq)
-            ):
-                best, best_key = kq, key
-        if best is None:
-            return None
-        item = best.items.popleft()
-        best.vt += 1.0 / best.weight
-        self._len -= 1
-        if not best.items:
-            del self._keys[best_key]
-        return best_key, item
-
-    def shed_from_largest(self) -> Optional[tuple]:
-        """Backlog-overflow victim selection: remove and return the NEWEST
-        item of the key holding the most queued requests — the flood pays
-        for the overflow it caused, not whoever arrives next.  Returns
-        None when no key is over-represented (max backlog 1 per key, e.g.
-        per-conn keys): the caller falls back to shedding the arrival,
-        since every key then has an equal, minimal claim."""
-        victim_key = None
-        victim: Optional[_KeyQueue] = None
-        for key, kq in self._keys.items():
-            if len(kq.items) >= 2 and (
-                victim is None or len(kq.items) > len(victim.items)
-            ):
-                victim_key, victim = key, kq
-        if victim is None:
-            return None
-        item = victim.items.pop()
-        self._len -= 1
-        if not victim.items:
-            del self._keys[victim_key]
-        return item
-
-    def remove_where(self, pred) -> int:
-        """Drop every queued item matching ``pred`` (e.g. a dead conn's
-        requests); returns how many were removed."""
-        removed = 0
-        for key in list(self._keys):
-            kq = self._keys[key]
-            kept = deque(i for i in kq.items if not pred(i))
-            removed += len(kq.items) - len(kept)
-            kq.items = kept
-            if not kept:
-                del self._keys[key]
-        self._len -= removed
-        return removed
+        self.add(key, item, weight)

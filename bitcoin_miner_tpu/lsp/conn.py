@@ -27,7 +27,7 @@ The handshake (rule 1) and wire codec (rule 8) live in the owners
 from __future__ import annotations
 
 from collections import deque
-from typing import Callable, Deque, Dict, List, Optional
+from typing import Callable, Deque, Dict
 
 from ..utils.metrics import METRICS
 from .message import Message

@@ -14,7 +14,7 @@ from __future__ import annotations
 import base64
 import binascii
 import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from enum import IntEnum
 from typing import Optional
 
@@ -81,7 +81,11 @@ class Message:
             if not isinstance(obj, dict):
                 return None
             raw = obj.get("Payload")
-            payload = None if raw is None else base64.standard_b64decode(raw)
+            # validate=True: Go's decoder errors on non-alphabet bytes;
+            # the permissive default would silently strip them and misread
+            # a corrupted datagram as a shorter payload (tools/analyze
+            # contracts pass, codec-poison rule).
+            payload = None if raw is None else base64.b64decode(raw, validate=True)
             return Message(
                 type=MsgType(int(obj.get("Type", 0))),
                 conn_id=int(obj.get("ConnID", 0)),

@@ -14,7 +14,7 @@ import asyncio
 import threading
 from concurrent.futures import CancelledError as FutureCancelledError
 from concurrent.futures import Future
-from typing import Optional, Tuple
+from typing import Any, Callable, Coroutine, Optional, Tuple
 
 from .aio import AsyncClient, AsyncServer
 from .errors import ConnClosedError
@@ -47,7 +47,7 @@ class _LoopThread:
         self._thread = threading.Thread(target=_run, name=name, daemon=True)
         self._thread.start()
 
-    def run(self, coro, timeout: Optional[float] = None):
+    def run(self, coro: "Coroutine", timeout: Optional[float] = None) -> Any:
         if self._stopping:
             coro.close()
             raise ConnClosedError()
@@ -64,12 +64,12 @@ class _LoopThread:
             # coroutine ran, which is NOT asyncio.CancelledError here.
             raise ConnClosedError()
 
-    def call(self, fn, *args):
+    def call(self, fn: Callable, *args: Any) -> Any:
         """Run a plain callable on the loop thread (for non-async mutations
         that must happen on the owning loop)."""
         done: Future = Future()
 
-        def _invoke():
+        def _invoke() -> None:
             try:
                 done.set_result(fn(*args))
             except BaseException as e:  # propagate to caller

@@ -178,21 +178,21 @@ class NetSim:
         # apply in time order even when several event-loop threads race
         # through _advance (replayability depends on it).
         self._sched_lock = threading.Lock()
-        self._seed = env_chaos_seed() or 0
-        self._default: LinkConditions = _CLEAN
-        self._per_key: Dict[str, LinkConditions] = {}
+        self._seed = env_chaos_seed() or 0  # guarded-by: _lock
+        self._default: LinkConditions = _CLEAN  # guarded-by: _lock
+        self._per_key: Dict[str, LinkConditions] = {}  # guarded-by: _lock
         # Partitioned endpoint keys per direction; None = everyone.
-        self._part_tx: set = set()
-        self._part_rx: set = set()
-        self._states: Dict[Tuple[str, str], _LinkState] = {}
-        self._counters: Dict[str, int] = {}
-        self._trace: Optional[List[Tuple]] = None
-        self._schedule: List[Tuple[float, Tuple[Callable, ...]]] = []
-        self._sched_idx = 0
-        self._loop_every: Optional[float] = None
-        self._t0 = 0.0
-        self._clock: Callable[[], float] = time.monotonic
-        self._enabled = False
+        self._part_tx: set = set()  # guarded-by: _lock
+        self._part_rx: set = set()  # guarded-by: _lock
+        self._states: Dict[Tuple[str, str], _LinkState] = {}  # guarded-by: _lock
+        self._counters: Dict[str, int] = {}  # guarded-by: _lock
+        self._trace: Optional[List[Tuple]] = None  # guarded-by: _lock
+        self._schedule: List[Tuple[float, Tuple[Callable, ...]]] = []  # guarded-by: _lock
+        self._sched_idx = 0  # guarded-by: _lock
+        self._loop_every: Optional[float] = None  # guarded-by: _lock
+        self._t0 = 0.0  # guarded-by: _lock
+        self._clock: Callable[[], float] = time.monotonic  # guarded-by: _lock
+        self._enabled = False  # guarded-by: _lock
 
     # ------------------------------------------------------------- lifecycle
 
@@ -285,7 +285,7 @@ class NetSim:
     def _partitioned_locked(self, parts: set, key: str, role: str) -> bool:
         return None in parts or key in parts or role in parts
 
-    def _refresh_enabled(self) -> None:
+    def _refresh_enabled(self) -> None:  # guarded-by: _lock
         self._enabled = bool(
             self._per_key
             or not self._default.quiet
@@ -316,9 +316,11 @@ class NetSim:
             self._sched_idx = 0
             self._loop_every = loop_every
             self._clock = clock
-            self._t0 = clock()
+            # Local alias: _advance below runs off-lock and must not read
+            # the field back (the lock pass flagged exactly that).
+            self._t0 = t0 = clock()
             self._enabled = True
-        self._advance(self._t0)
+        self._advance(t0)
 
     def _advance(self, now: float) -> None:
         """Apply every scheduled step whose time has come.  Steps call the
@@ -357,10 +359,10 @@ class NetSim:
 
     def on_send(self, label: Optional[str], is_server: bool) -> Decision:
         """Decide one outbound packet's fate.  Called by UDPEndpoint.send."""
-        if not self._enabled:
+        if not self._enabled:  # unguarded: benign racy fast path — a stale False costs one clean packet, never a wrong decision
             return _PASS
-        if self._schedule:
-            self._advance(self._clock())
+        if self._schedule:  # unguarded: racy peek; _advance re-checks under _lock
+            self._advance(self._clock())  # unguarded: _clock is set once per run()
         role = "server" if is_server else "client"
         key = label or role
         with self._lock:
@@ -412,10 +414,10 @@ class NetSim:
         """True if this inbound packet should be discarded — rx partitions
         only; loss/delay/reorder/dup are all modeled on the tx side (any
         A→B link is shaped at A's tx, severed at either end)."""
-        if not self._enabled:
+        if not self._enabled:  # unguarded: benign racy fast path (see on_send)
             return False
-        if self._schedule:
-            self._advance(self._clock())
+        if self._schedule:  # unguarded: racy peek; _advance re-checks under _lock
+            self._advance(self._clock())  # unguarded: _clock is set once per run()
         role = "server" if is_server else "client"
         key = label or role
         with self._lock:
@@ -432,11 +434,11 @@ class NetSim:
             )
         return st
 
-    def _count(self, what: str) -> None:
+    def _count(self, what: str) -> None:  # guarded-by: _lock
         self._counters[what] = self._counters.get(what, 0) + 1
         METRICS.inc(f"chaos.{what}")
 
-    def _note(self, key, direction, what, decision):
+    def _note(self, key, direction, what, decision):  # guarded-by: _lock
         self._count(what)
         if self._trace is not None:
             self._trace.append((key, direction, what))

@@ -19,7 +19,7 @@ from typing import Deque, Dict, Optional, Tuple
 class Metrics:
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._counters: Dict[str, int] = defaultdict(int)
+        self._counters: Dict[str, int] = defaultdict(int)  # guarded-by: _lock
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -88,11 +88,11 @@ class RateMeter:
     ) -> None:
         if window is not None and window <= 0:
             raise ValueError(f"window must be positive, got {window}")
-        self._clock = clock
-        self._window = window
-        self._t0 = clock()
-        self._n = 0
-        self._events: Deque[Tuple[float, int]] = deque()
+        self._clock = clock  # immutable after construction
+        self._window = window  # immutable after construction
+        self._t0 = clock()  # immutable after construction
+        self._n = 0  # guarded-by: _lock
+        self._events: Deque[Tuple[float, int]] = deque()  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def add(self, n: int) -> None:
@@ -110,7 +110,7 @@ class RateMeter:
                     self._events.append((now, n))
                 self._prune(now)
 
-    def _prune(self, now: float) -> None:
+    def _prune(self, now: float) -> None:  # guarded-by: _lock
         horizon = now - self._window
         while self._events and self._events[0][0] < horizon:
             self._events.popleft()

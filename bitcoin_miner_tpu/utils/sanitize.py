@@ -1,0 +1,279 @@
+"""Opt-in runtime race sanitizer — the ``go test -race`` analogue.
+
+The reference Go stack gets data-race detection from its toolchain; this
+Python port's thread discipline (the serve-loop event lock serializing the
+read loop and the ticker, the externally-serialized policy objects) is
+otherwise enforced only by convention and by ``tools/analyze``'s static
+pass.  With ``BMT_SANITIZE=1`` the dynamic half arms:
+
+- :class:`TrackedLock` (via :func:`make_lock`) is a drop-in
+  ``threading.Lock`` that records its owner thread and every thread's
+  held-lock stack, and maintains a process-global **acquisition-order
+  graph**: acquiring B while holding A adds the edge A→B, and any edge
+  that closes a cycle raises :class:`LockOrderError` at the acquisition
+  that would deadlock — deterministically, not only on the unlucky
+  interleaving.
+- :func:`guard` wraps a policy object (Scheduler, Gateway, ResultCache —
+  the registry in ``tools/analyze/registry.py``) in a :class:`Monitor`
+  proxy.  Every attribute read and method call checks the discipline:
+  once a second thread has touched the object, every access must hold the
+  object's lock; a violation raises :class:`RaceError` naming the object,
+  attribute and both threads.  Method entries are additionally tracked so
+  two threads truly interleaving inside the same object are caught even
+  before the thread-set heuristic trips.
+
+Disabled (the default), :func:`make_lock` returns a plain
+``threading.Lock`` and :func:`guard` returns the object unchanged — zero
+overhead on the hot path.  The chaos soak and gateway suites run green
+under ``BMT_SANITIZE=1`` (tests/test_analyze.py pins that), so races
+surface under burst loss, not in production.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional, Set, Tuple
+
+__all__ = [
+    "RaceError",
+    "LockOrderError",
+    "TrackedLock",
+    "Monitor",
+    "enabled",
+    "force",
+    "make_lock",
+    "guard",
+    "reset_order_graph",
+]
+
+
+class RaceError(AssertionError):
+    """Unsynchronized concurrent access to a guarded object."""
+
+
+class LockOrderError(AssertionError):
+    """A lock acquisition that closes a cycle in the acquisition-order
+    graph — the interleaving-dependent deadlock, caught deterministically."""
+
+
+#: Test override: force(True/False) beats the environment; force(None)
+#: restores env control.
+_FORCED: Optional[bool] = None
+
+
+def enabled() -> bool:
+    if _FORCED is not None:
+        return _FORCED
+    return os.environ.get("BMT_SANITIZE", "") not in ("", "0")
+
+
+def force(on: Optional[bool]) -> None:
+    """Override BMT_SANITIZE for in-process tests (None = back to env)."""
+    global _FORCED
+    _FORCED = on
+
+
+# --------------------------------------------------------------------------
+# Lock-order graph (process-global, like the locks it observes)
+# --------------------------------------------------------------------------
+
+
+class _OrderGraph:
+    """Directed acquisition-order edges between lock names.  ``observe``
+    raises the moment an acquisition would add an edge that closes a
+    cycle — i.e. some thread has ever taken the locks in the opposite
+    order, the classic ABBA deadlock whether or not it bit this run."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._edges: Dict[str, Set[str]] = {}  # guarded-by: _mu
+
+    def observe(self, held: Tuple[str, ...], acquiring: str) -> None:
+        with self._mu:
+            for h in held:
+                if h == acquiring:
+                    continue  # re-entrant same-name acquisition
+                self._edges.setdefault(h, set()).add(acquiring)
+            # A cycle exists iff the new lock can reach any held one.
+            for h in held:
+                if h != acquiring and self._reaches(acquiring, h):
+                    raise LockOrderError(
+                        f"lock-order inversion: acquiring {acquiring!r} while "
+                        f"holding {h!r}, but {acquiring!r} -> ... -> {h!r} "
+                        f"already exists in the acquisition graph "
+                        f"(thread {threading.current_thread().name})"
+                    )
+
+    def _reaches(self, src: str, dst: str) -> bool:  # guarded-by: _mu
+        seen: Set[str] = set()
+        stack = [src]
+        while stack:
+            node = stack.pop()
+            if node == dst:
+                return True
+            if node in seen:
+                continue
+            seen.add(node)
+            stack.extend(self._edges.get(node, ()))
+        return False
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+
+
+_ORDER = _OrderGraph()
+_HELD = threading.local()  # per-thread stack of held TrackedLock names
+
+
+def _held_stack() -> list:
+    stack = getattr(_HELD, "stack", None)
+    if stack is None:
+        stack = _HELD.stack = []
+    return stack
+
+
+def reset_order_graph() -> None:
+    """Forget past acquisition orders (test isolation between scenarios)."""
+    _ORDER.reset()
+
+
+class TrackedLock:
+    """``threading.Lock`` plus ownership + acquisition-order tracking.
+
+    Non-reentrant, like the lock it replaces.  ``held()`` answers "does
+    the *current thread* hold this lock" — the question a plain Lock
+    cannot answer and the Monitor discipline check needs."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._owner: Optional[int] = None  # thread ident; _lock serializes
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        _ORDER.observe(tuple(_held_stack()), self.name)
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._owner = threading.get_ident()
+            _held_stack().append(self.name)
+        return got
+
+    def release(self) -> None:
+        self._owner = None
+        stack = _held_stack()
+        if self.name in stack:
+            stack.remove(self.name)
+        self._lock.release()
+
+    def held(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+
+def make_lock(name: str) -> Any:
+    """The serve loop's lock factory: tracked when sanitizing, plain
+    ``threading.Lock`` (zero overhead) otherwise."""
+    return TrackedLock(name) if enabled() else threading.Lock()
+
+
+# --------------------------------------------------------------------------
+# Guarded-object monitor
+# --------------------------------------------------------------------------
+
+
+class Monitor:
+    """Attribute-level discipline proxy around one guarded object.
+
+    The rule: an object may be thread-confined (only one thread has ever
+    touched it — the single-threaded setup window before the ticker
+    starts), but once a second thread appears, EVERY access must hold the
+    guarding lock.  Lock-held accesses are always legal and enroll the
+    accessing thread.  Method calls additionally mark the object
+    "entered", so two threads interleaving inside methods are reported
+    even on the first offense.
+    """
+
+    __slots__ = ("_obj", "_lock", "_name", "_mu", "_threads", "_inside")
+
+    def __init__(self, obj: Any, lock: TrackedLock, name: str) -> None:
+        object.__setattr__(self, "_obj", obj)
+        object.__setattr__(self, "_lock", lock)
+        object.__setattr__(self, "_name", name)
+        object.__setattr__(self, "_mu", threading.Lock())
+        object.__setattr__(self, "_threads", set())
+        object.__setattr__(self, "_inside", None)  # (ident, attr) mid-call
+
+    def __check(self, attr: str) -> None:
+        lock: TrackedLock = self._lock
+        me = threading.get_ident()
+        if isinstance(lock, TrackedLock) and lock.held():
+            with self._mu:
+                self._threads.add(me)
+            return
+        with self._mu:
+            self._threads.add(me)
+            if len(self._threads) > 1:
+                raise RaceError(
+                    f"unsynchronized access to {self._name}.{attr} from "
+                    f"thread {threading.current_thread().name} without "
+                    f"holding {getattr(lock, 'name', 'the lock')!r} "
+                    f"(object already shared by {len(self._threads)} threads)"
+                )
+
+    def __getattr__(self, attr: str) -> Any:
+        self._Monitor__check(attr)
+        val = getattr(self._obj, attr)
+        if not callable(val):
+            return val
+        monitor = self
+
+        def guarded_call(*args: Any, **kw: Any) -> Any:
+            monitor._Monitor__check(attr)
+            me = threading.get_ident()
+            locked = isinstance(monitor._lock, TrackedLock) and monitor._lock.held()
+            with monitor._mu:
+                inside = monitor._inside
+                if inside is not None and inside[0] != me and not locked:
+                    raise RaceError(
+                        f"concurrent method entry on {monitor._name}: "
+                        f"{attr} from {threading.current_thread().name} "
+                        f"while {inside[1]} is running in another thread"
+                    )
+                outer = inside is None and not locked
+                if outer:
+                    object.__setattr__(monitor, "_inside", (me, attr))
+            try:
+                return val(*args, **kw)
+            finally:
+                if outer:
+                    with monitor._mu:
+                        object.__setattr__(monitor, "_inside", None)
+
+        return guarded_call
+
+    def __setattr__(self, attr: str, value: Any) -> None:
+        self._Monitor__check(attr)
+        setattr(self._obj, attr, value)
+
+    def __len__(self) -> int:
+        self._Monitor__check("__len__")
+        return len(self._obj)
+
+
+def guard(obj: Any, lock: Any, name: str) -> Any:
+    """Wrap ``obj`` in a :class:`Monitor` bound to ``lock`` when the
+    sanitizer is armed; return it unchanged otherwise (or when the lock is
+    a plain ``threading.Lock`` — ownership is unknowable there)."""
+    if not enabled() or not isinstance(lock, TrackedLock):
+        return obj
+    return Monitor(obj, lock, name)

@@ -1,0 +1,8 @@
+"""Seeded-violation fixtures for tools/analyze (tests/test_analyze.py).
+
+One file per pass, one deliberate violation per rule.  These are the
+analyzer's own regression suite: every rule must FIRE here and stay
+quiet on the live repo.  Never "fix" these files — they are wrong on
+purpose; pytest does not collect them (no test_ prefix) and the repo-mode
+analyzer does not scan tests/.
+"""

@@ -1,0 +1,47 @@
+"""Seeded frozen-contract violations (tools/analyze contracts pass).
+
+A deliberately drifted wire codec and hash function: field order changed,
+separators loosened, hash constant wrong — the exact classes of silent
+drift the golden vectors exist to catch.
+"""
+
+import json
+from dataclasses import dataclass
+
+
+@dataclass
+class Message:
+    type: int = 0
+    data: str = ""
+    lower: int = 0
+    upper: int = 0
+    hash: int = 0
+    nonce: int = 0
+
+    @staticmethod
+    def join():
+        return Message(type=0)
+
+    @staticmethod
+    def request(data, lower, upper):
+        return Message(type=1, data=data, lower=lower, upper=upper)
+
+    @staticmethod
+    def result(hash_, nonce):
+        return Message(type=2, hash=hash_, nonce=nonce)
+
+    def marshal(self):
+        # DRIFTED: lower-case keys, default separators (spaces), new field
+        # order — byte-incompatible with the frozen Go-JSON contract.
+        return json.dumps(
+            {"type": self.type, "nonce": self.nonce, "hash": self.hash,
+             "data": self.data, "lower": self.lower, "upper": self.upper}
+        ).encode()
+
+    @staticmethod
+    def unmarshal(buf):
+        return None  # DRIFTED: cannot round-trip the frozen bytes
+
+
+def hash_nonce(msg, nonce):
+    return 0  # DRIFTED: every golden hash vector misses

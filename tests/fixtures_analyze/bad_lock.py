@@ -1,0 +1,38 @@
+"""Seeded lock-discipline violations (tools/analyze lock pass).
+
+Every rule the AST checker implements has one deliberate offense here.
+"""
+
+import threading
+
+
+class LeakyCounter:
+    """Field annotated guarded-by, then read off-lock: field-off-lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0  # guarded-by: _lock
+
+    def inc(self):
+        with self._lock:
+            self._count += 1
+
+    def read_off_lock(self):
+        return self._count  # SEEDED VIOLATION: no `with self._lock:`
+
+    def _drain_locked(self):
+        self._count = 0  # legal: _locked suffix = caller holds the lock
+
+    def helper(self):  # guarded-by: _lock
+        return self._count  # legal: def-line annotation = runs under lock
+
+    def call_helper_off_lock(self):
+        return self.helper()  # SEEDED VIOLATION: helper-off-lock
+
+
+def serve_like(thing):
+    lock = threading.Lock()
+    state = thing  # guarded-by: lock
+    with lock:
+        state.ok()  # legal
+    state.leak()  # SEEDED VIOLATION: local-off-lock

@@ -1,0 +1,39 @@
+"""Seeded runtime races (tools/analyze sanitize pass, BMT_SANITIZE=1).
+
+Each ``provoke_*`` commits one concurrency crime against the sanitizer's
+machinery; the pass runs them all and reports every RaceError /
+LockOrderError raised — the proof the ``-race`` analogue actually fires.
+"""
+
+import threading
+
+from bitcoin_miner_tpu.utils import sanitize
+
+
+def provoke_unsynchronized_access():
+    """Off-lock access to a guarded object after a second thread shared
+    it — the health-line-stat-read-off-lock bug class."""
+    lock = sanitize.TrackedLock("fixture.lock")
+    shared = sanitize.Monitor({"n": 0}, lock, "fixture-state")
+
+    def disciplined_toucher():
+        with lock:
+            shared.keys()
+
+    t = threading.Thread(target=disciplined_toucher)
+    t.start()
+    t.join()
+    shared.keys()  # SEEDED VIOLATION: off-lock once shared -> RaceError
+
+
+def provoke_lock_order_inversion():
+    """ABBA acquisition — raises deterministically from the acquisition
+    graph even though this single-threaded run could never deadlock."""
+    a = sanitize.TrackedLock("fixture.A")
+    b = sanitize.TrackedLock("fixture.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # SEEDED VIOLATION: closes the A->B->A cycle
+            pass

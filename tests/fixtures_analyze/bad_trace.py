@@ -1,0 +1,31 @@
+"""Seeded JAX trace-safety violations (tools/analyze trace pass).
+
+AST-scanned only, never imported — the imports exist so the file reads
+like real kernel code.  One offense per rule.
+"""
+
+import random
+import time
+from functools import lru_cache
+
+import jax
+
+
+def make_bad_kernel(n_lanes):
+    def kernel(x, bounds):
+        if x > 0:  # SEEDED VIOLATION: trace-branch (Python if on a tracer)
+            y = x + 1
+        else:
+            y = x
+        z = int(x)  # SEEDED VIOLATION: trace-concretize (int() on a tracer)
+        w = x.item()  # SEEDED VIOLATION: trace-concretize (.item() fetch)
+        t0 = time.time()  # SEEDED VIOLATION: trace-wallclock
+        r = random.random()  # SEEDED VIOLATION: trace-rng
+        return y, z, w, t0, r
+
+    return jax.jit(kernel)
+
+
+@lru_cache(maxsize=8)
+def bad_factory(shape=[8, 128]):  # SEEDED VIOLATION: trace-unhashable-static
+    return shape

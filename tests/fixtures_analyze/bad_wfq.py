@@ -1,0 +1,16 @@
+"""Seeded WFQ-reimplementation violations (tools/analyze wfq pass).
+
+Both idioms the single-WFQ rule hunts: the floor init and the
+``(vt, seq)`` tie-break, hand-rolled outside utils/wfq.py.
+"""
+
+
+def pick_lowest(queues):
+    # SEEDED VIOLATION (floor-init-reimplemented):
+    floor = min((q.vt for q in queues if q.items), default=0.0)
+    best = None
+    for q in queues:
+        # SEEDED VIOLATION (tiebreak-reimplemented):
+        if best is None or (q.vt, q.seq) < (best.vt, best.seq):
+            best = q
+    return best, floor

@@ -1,0 +1,336 @@
+"""The repo-native analysis suite, both directions (ISSUE 4).
+
+- The live repo passes every pass clean (``python -m tools.analyze``
+  exits 0) — this is the tier-1 gate every future PR runs.
+- Every rule FIRES on its seeded fixture violation
+  (tests/fixtures_analyze): an analyzer that cannot detect certifies
+  nothing.
+- The runtime race sanitizer's primitives (TrackedLock ownership,
+  acquisition-order graph, Monitor discipline) unit-tested directly, and
+  the only-shrink ratchet mechanics.
+
+The BMT_SANITIZE=1 integration legs live with the suites they harden:
+tests/test_chaos_soak.py (sanitized fast drill) and tests/test_gateway.py
+(sanitized duplicate-heavy fleet).
+"""
+
+import importlib.util
+import json
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.analysis
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures_analyze"
+
+if str(REPO) not in sys.path:  # make `tools.analyze` importable in-process
+    sys.path.insert(0, str(REPO))
+
+from tools.analyze import PASSES, apply_ratchet, load_ratchet, save_ratchet
+from tools.analyze import contracts as contracts_pass
+from tools.analyze.common import DEFAULT_SCAN_DIRS, Finding
+from tools.analyze.tracecheck import TRACE_SCAN_DIRS
+
+from bitcoin_miner_tpu.utils import sanitize
+
+
+def _pass_findings(name, root, scan=None):
+    return PASSES[name](root, scan)
+
+
+# --------------------------------------------------------------------------
+# 1. The live repo is clean
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["lock", "wfq", "trace", "contracts", "sanitize"])
+def test_repo_is_clean(name):
+    scan = TRACE_SCAN_DIRS if name == "trace" else DEFAULT_SCAN_DIRS
+    findings = _pass_findings(name, REPO, scan)
+    ratchet = load_ratchet(REPO / "tools" / "analyze" / "ratchet.json")
+    new, stale = apply_ratchet(findings, ratchet)
+    assert not new, "\n".join(f.render() for f in new)
+    assert not stale, stale
+
+
+def test_cli_repo_mode_exits_zero():
+    """The command every future PR runs — fast, CPU-safe, no network."""
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "-q"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_fixture_mode_exits_nonzero():
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--root", str(FIXTURES)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    # Every pass contributed at least one finding to the output.
+    for tag in ("[lock/", "[wfq/", "[contracts/", "[trace/", "[sanitize/"):
+        assert tag in res.stdout, f"{tag} never fired:\n{res.stdout}"
+
+
+# --------------------------------------------------------------------------
+# 2. Every rule fires on its seeded fixture
+# --------------------------------------------------------------------------
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def test_lock_rules_fire_on_fixture():
+    rules = _rules(_pass_findings("lock", FIXTURES))
+    assert {"field-off-lock", "helper-off-lock", "local-off-lock"} <= rules
+
+
+def test_wfq_rules_fire_on_fixture():
+    rules = _rules(_pass_findings("wfq", FIXTURES))
+    assert {"floor-init-reimplemented", "tiebreak-reimplemented"} <= rules
+
+
+def test_trace_rules_fire_on_fixture():
+    rules = _rules(_pass_findings("trace", FIXTURES))
+    assert {
+        "trace-branch",
+        "trace-concretize",
+        "trace-wallclock",
+        "trace-rng",
+        "trace-unhashable-static",
+    } <= rules
+
+
+def test_contract_rules_fire_on_drifted_codec():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bad_contract", FIXTURES / "bad_contract.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    findings = contracts_pass.run(
+        FIXTURES, None, modules={"bitcoin_message": mod, "hash": mod}
+    )
+    rules = _rules(findings)
+    assert {"codec-marshal", "codec-roundtrip", "hash-vector"} <= rules
+
+
+def test_sanitize_pass_fires_on_fixture():
+    findings = _pass_findings("sanitize", FIXTURES)
+    provoked = {f.symbol for f in findings}
+    assert {
+        "provoke_unsynchronized_access",
+        "provoke_lock_order_inversion",
+    } <= provoked
+
+
+def test_trace_pass_does_not_flag_static_branches(tmp_path):
+    """The taint heuristic must not cry wolf on the repo's real kernel
+    idioms: static Python loops/branches and dict-membership over static
+    keys inside a kernel factory."""
+    clean = tmp_path / "clean_kernel.py"
+    clean.write_text(
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "def make_kernel(n_blocks, k):\n"
+        "    def kernel(midstate, bounds):\n"
+        "        i = jnp.arange(10 ** k)\n"
+        "        contrib = {}\n"
+        "        for b in range(n_blocks):\n"
+        "            contrib[b] = i + b\n"
+        "        w = []\n"
+        "        for widx in range(16):\n"
+        "            if widx in contrib:\n"
+        "                w.append(contrib[widx])\n"
+        "        if n_blocks > 1:\n"
+        "            w.append(jnp.min(i))\n"
+        "        return w\n"
+        "    return jax.jit(kernel)\n"
+    )
+    assert _pass_findings("trace", tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# 3. Ratchet mechanics: the grandfather list may only shrink
+# --------------------------------------------------------------------------
+
+
+def _finding(rule="r", path="p.py", symbol="s"):
+    return Finding("lock", rule, path, 1, symbol, "msg")
+
+
+def test_ratchet_grandfathers_up_to_count_and_flags_excess():
+    ratchet = {_finding().key: 1}
+    new, stale = apply_ratchet([_finding(), _finding()], ratchet)
+    assert len(new) == 1 and not stale  # one allowed, one new
+
+
+def test_ratchet_stale_entry_must_shrink():
+    ratchet = {_finding().key: 2}
+    new, stale = apply_ratchet([_finding()], ratchet)
+    assert not new
+    assert stale == [_finding().key]  # fired 1 < recorded 2: shrink the file
+
+
+def test_ratchet_save_load_roundtrip(tmp_path):
+    path = tmp_path / "ratchet.json"
+    save_ratchet(path, [_finding(), _finding(), _finding(rule="other")])
+    loaded = load_ratchet(path)
+    assert loaded[_finding().key] == 2
+    assert loaded[_finding(rule="other").key] == 1
+    assert "only shrink" in json.loads(path.read_text())["comment"]
+
+
+def test_checked_in_ratchet_is_empty():
+    """The repo carries no grandfathered debt today; if a future PR must
+    add some, it does so explicitly — and the file can then only shrink."""
+    assert load_ratchet(REPO / "tools" / "analyze" / "ratchet.json") == {}
+
+
+# --------------------------------------------------------------------------
+# 4. ruff + mypy (configured in pyproject.toml; the image may not ship the
+#    tools — skip, don't fail, so tier-1 stays hermetic)
+# --------------------------------------------------------------------------
+
+
+def _have(tool: str) -> bool:
+    return importlib.util.find_spec(tool) is not None
+
+
+@pytest.mark.skipif(not _have("ruff"), reason="ruff not installed in this image")
+def test_ruff_clean():
+    res = subprocess.run(
+        [sys.executable, "-m", "ruff", "check", "bitcoin_miner_tpu", "tools", "tests"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+@pytest.mark.skipif(not _have("mypy"), reason="mypy not installed in this image")
+def test_mypy_clean():
+    res = subprocess.run(
+        [sys.executable, "-m", "mypy", "--no-error-summary"],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+# --------------------------------------------------------------------------
+# 5. Race-sanitizer primitives
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def sanitizer():
+    sanitize.force(True)
+    sanitize.reset_order_graph()
+    yield sanitize
+    sanitize.force(None)
+    sanitize.reset_order_graph()
+
+
+def test_tracked_lock_ownership(sanitizer):
+    lock = sanitize.TrackedLock("t.own")
+    assert not lock.held()
+    with lock:
+        assert lock.held()
+        box = {}
+
+        def peek():
+            box["other"] = lock.held()
+
+        t = threading.Thread(target=peek)
+        t.start()
+        t.join()
+        assert box["other"] is False  # held() is per-thread, not per-lock
+    assert not lock.held()
+
+
+def test_lock_order_graph_is_transitive(sanitizer):
+    a, b, c = (sanitize.TrackedLock(n) for n in ("g.A", "g.B", "g.C"))
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(sanitize.LockOrderError):
+        with c:
+            with a:  # A->B->C->A: caught via transitivity, not direct edge
+                pass
+
+
+def test_monitor_allows_thread_confined_use(sanitizer):
+    lock = sanitize.TrackedLock("t.confined")
+    obj = sanitize.guard({"n": 1}, lock, "conf")
+    assert obj.keys() is not None  # single-threaded, off-lock: the setup window
+
+
+def test_monitor_raises_once_shared(sanitizer):
+    lock = sanitize.TrackedLock("t.shared")
+    obj = sanitize.guard({"n": 1}, lock, "shared")
+
+    def locked_touch():
+        with lock:
+            obj.keys()
+
+    t = threading.Thread(target=locked_touch)
+    t.start()
+    t.join()
+    with pytest.raises(sanitize.RaceError):
+        obj.keys()
+    with lock:
+        obj.keys()  # disciplined access still fine
+
+
+def test_guard_is_identity_when_disabled():
+    sanitize.force(False)
+    try:
+        lock = sanitize.make_lock("t.off")
+        assert isinstance(lock, type(threading.Lock()))
+        obj = {"n": 1}
+        assert sanitize.guard(obj, lock, "x") is obj
+    finally:
+        sanitize.force(None)
+
+
+def test_serve_loop_discipline_clean_under_monitor(sanitizer):
+    """The exact shape serve() runs: scheduler behind a Monitor, read loop
+    + ticker threads, all access under the event lock — silent."""
+    from bitcoin_miner_tpu.apps.scheduler import Scheduler
+
+    lock = sanitize.make_lock("t.serve")
+    sched = sanitize.guard(Scheduler(), lock, "scheduler")
+    errors = []
+
+    def actor(event_fn):
+        try:
+            for i in range(100):
+                with lock:
+                    event_fn(i)
+        except BaseException as e:
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=actor, args=(lambda i: sched.tick(float(i)),)),
+        threading.Thread(target=actor, args=(lambda i: sched.stats(),)),
+        threading.Thread(target=actor, args=(lambda i: sched.drain_evictions(),)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors

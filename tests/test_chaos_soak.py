@@ -472,6 +472,29 @@ def test_fast_seeded_scenario_oracle_exact():
     assert report.counters.get("chaos.dropped", 0) > 0, report.as_dict()
 
 
+@pytest.mark.analysis
+def test_fast_scenario_green_under_race_sanitizer():
+    """The same tier-1 burst-loss drill with BMT_SANITIZE=1 machinery
+    armed: serve()'s event lock becomes a TrackedLock, the scheduler a
+    Monitor, and any off-lock access or lock-order inversion in the
+    read-loop/ticker weave aborts the fleet — so the drill only passes
+    if the serve-loop discipline holds under packet loss and reconnect
+    churn (ISSUE 4 acceptance: the chaos soak runs green sanitized)."""
+    from bitcoin_miner_tpu.utils import sanitize
+
+    sanitize.force(True)
+    sanitize.reset_order_graph()
+    try:
+        report = run_drill(
+            "burst-loss", seed=17, data="sanichaos", max_nonce=2000,
+            n_miners=2, timeout=90.0,
+        )
+        assert report.ok, report.as_dict()
+    finally:
+        sanitize.force(None)
+        sanitize.reset_order_graph()
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize(
     "scenario,seed,kill_at",

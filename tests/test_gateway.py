@@ -612,6 +612,45 @@ def test_gateway_fleet_shed_conn_sees_disconnected():
         fleet.close()
 
 
+@pytest.mark.analysis
+def test_gateway_fleet_green_under_race_sanitizer():
+    """Duplicate-heavy serving with BMT_SANITIZE=1 machinery armed: the
+    gateway (coalescing + cache + admission state) runs behind a Monitor
+    on serve()'s TrackedLock, so any off-lock touch of the serving-layer
+    state during concurrent client bursts aborts the fleet.  Green here
+    means the gateway's "under the event lock" discipline is enforced by
+    machinery, not comments (ISSUE 4)."""
+    from bitcoin_miner_tpu.utils import sanitize
+
+    sanitize.force(True)
+    sanitize.reset_order_graph()
+    fleet = None
+    try:
+        fleet = GatewayFleet(n_miners=2)
+        want = min_hash_range("gwsani", 0, 2500)
+        out = {}
+
+        def one(i):
+            out[i] = fleet.request("gwsani", 2500)
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "client starved under sanitizer"
+        # len check first: a RaceError inside a client thread would kill it
+        # before it writes out[i] — iterating only surviving keys would
+        # pass vacuously and mask exactly what this test exists to catch.
+        assert len(out) == 4, f"client thread(s) died: {sorted(out)}"
+        assert all(out[i] == want for i in out), out
+    finally:
+        if fleet is not None:
+            fleet.close()
+        sanitize.force(None)
+        sanitize.reset_order_graph()
+
+
 def test_gateway_cache_persists_across_fleet_restart(tmp_path):
     """Fleet 1 solves a job; fleet 2 (fresh server+scheduler, same cache
     file) answers the repeat with no miners at all."""

@@ -1,0 +1,136 @@
+"""CLI for the analysis suite: ``python -m tools.analyze``.
+
+Exit 0 when every pass is clean (modulo the only-shrink ratchet),
+non-zero on any new finding or stale ratchet entry.  Tier-1 runs this on
+every PR (tests/test_analyze.py), so the passes stay fast,
+``JAX_PLATFORMS=cpu``-safe, and network-free.
+
+    python -m tools.analyze                      # all passes, repo mode
+    python -m tools.analyze --pass lock,wfq      # a subset
+    python -m tools.analyze --root tests/fixtures_analyze   # fixture tree
+    python -m tools.analyze --update-ratchet     # after FIXING findings
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List
+
+from . import PASSES
+from .common import (
+    DEFAULT_SCAN_DIRS,
+    REPO_ROOT,
+    Finding,
+    apply_ratchet,
+    load_ratchet,
+    save_ratchet,
+)
+from .tracecheck import TRACE_SCAN_DIRS
+
+DEFAULT_RATCHET = Path(__file__).resolve().parent / "ratchet.json"
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.analyze")
+    ap.add_argument(
+        "--pass",
+        dest="passes",
+        default="all",
+        help=f"comma-separated subset of: {','.join(PASSES)} (default all)",
+    )
+    ap.add_argument(
+        "--root",
+        default=None,
+        help="scan this tree instead of the repo (fixture mode: contracts/"
+        "sanitize pick up bad_contract.py / bad_race.py found under it)",
+    )
+    ap.add_argument(
+        "--ratchet",
+        default=None,
+        help="grandfather file (default tools/analyze/ratchet.json in repo "
+        "mode, none in --root mode)",
+    )
+    ap.add_argument(
+        "--update-ratchet",
+        action="store_true",
+        help="rewrite the ratchet from current findings (only for locking "
+        "in FIXES — never to admit new findings)",
+    )
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    names = (
+        list(PASSES) if args.passes == "all" else [p.strip() for p in args.passes.split(",")]
+    )
+    unknown = [n for n in names if n not in PASSES]
+    if unknown:
+        print(f"unknown pass(es): {', '.join(unknown)}", file=sys.stderr)
+        return 2
+
+    repo_mode = args.root is None
+    root = REPO_ROOT if repo_mode else Path(args.root).resolve()
+
+    findings: List[Finding] = []
+    for name in names:
+        run = PASSES[name]
+        if repo_mode:
+            scan = TRACE_SCAN_DIRS if name == "trace" else DEFAULT_SCAN_DIRS
+        else:
+            scan = None  # the whole fixture tree
+        if name == "contracts" and not repo_mode:
+            bad = list(root.rglob("bad_contract.py"))
+            if not bad:
+                continue  # nothing to check against in this tree
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location("bad_contract", bad[0])
+            assert spec is not None and spec.loader is not None
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            findings.extend(
+                run(root, None, modules={
+                    "bitcoin_message": mod,
+                    "hash": mod,
+                })
+            )
+            continue
+        findings.extend(run(root, scan))
+
+    ratchet_path = (
+        Path(args.ratchet)
+        if args.ratchet
+        else (DEFAULT_RATCHET if repo_mode else None)
+    )
+    if args.update_ratchet:
+        if ratchet_path is None:
+            print("--update-ratchet needs a ratchet path", file=sys.stderr)
+            return 2
+        save_ratchet(ratchet_path, findings)
+        print(f"ratchet rewritten: {len(findings)} grandfathered finding(s)")
+        return 0
+
+    ratchet = load_ratchet(ratchet_path) if ratchet_path else {}
+    new, stale = apply_ratchet(findings, ratchet)
+    grandfathered = len(findings) - len(new)
+
+    for f in new:
+        print(f.render())
+    for key in stale:
+        print(
+            f"stale ratchet entry: {key} no longer fires at its recorded "
+            f"count — shrink tools/analyze/ratchet.json (the only-shrink "
+            f"contract: fixed findings stay fixed)"
+        )
+    if not args.quiet:
+        print(
+            f"tools.analyze: {len(names)} pass(es), {len(new)} new finding(s), "
+            f"{grandfathered} grandfathered, {len(stale)} stale ratchet "
+            f"entr{'y' if len(stale) == 1 else 'ies'}"
+        )
+    return 1 if new or stale else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,423 @@
+"""Pass 1: the AST lock-discipline checker.
+
+Annotation vocabulary (see README "Static analysis & sanitizers"):
+
+- ``self.field = ...  # guarded-by: _lock`` — every access to
+  ``self.field`` outside ``__init__`` must happen inside a
+  ``with self._lock:`` block, in a method whose name ends in ``_locked``,
+  or in a method whose ``def`` line carries its own
+  ``# guarded-by: _lock`` (the called-under-the-lock helper convention).
+- ``def _helper(self):  # guarded-by: _lock`` — the helper body is
+  assumed to hold ``_lock`` (callee side), and every ``self._helper()``
+  call site must itself hold ``_lock`` (caller side).
+- ``var = ...  # guarded-by: lock`` on a function local — the serve-loop
+  discipline: every *read* of ``var`` in that function and its closures
+  must be inside ``with lock:``.  Nested ``def``s annotated the same way
+  are assumed-holding and get the call-site check.
+- ``# unguarded: <reason>`` anywhere on the statement suppresses the
+  finding (the documented-intentional escape hatch, e.g. the chaos
+  engine's benign racy ``_enabled`` fast path).
+
+Two registry rules ride along: externally-serialized policy classes
+(Scheduler, Gateway, ...) must never grow a ``threading.`` dependency,
+and internally-locked classes must not lose their annotations entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import (
+    GUARDED_BY_RE,
+    UNGUARDED_RE,
+    Finding,
+    comment_in_span,
+    file_comments,
+    iter_py_files,
+    rel,
+)
+from .registry import EXTERNAL_CLASSES, INTERNAL_CLASSES
+
+PASS = "lock"
+
+
+def _stmt_suppressed(comments: Dict[int, str], stmt: ast.stmt) -> bool:
+    return (
+        comment_in_span(
+            comments, stmt.lineno, getattr(stmt, "end_lineno", None), UNGUARDED_RE
+        )
+        is not None
+    )
+
+
+def _def_line_guard(comments: Dict[int, str], fn: ast.FunctionDef) -> Optional[str]:
+    """A ``# guarded-by: X`` on the def line (not the whole body)."""
+    text = comments.get(fn.lineno)
+    if text:
+        m = GUARDED_BY_RE.search(text)
+        if m:
+            return m.group(1)
+    return None
+
+
+def _with_locks(stmt: ast.With) -> Set[str]:
+    """Lock names a ``with`` statement acquires: ``self.X`` -> X,
+    bare ``name`` -> name."""
+    out: Set[str] = set()
+    for item in stmt.items:
+        e = item.context_expr
+        if (
+            isinstance(e, ast.Attribute)
+            and isinstance(e.value, ast.Name)
+            and e.value.id == "self"
+        ):
+            out.add(e.attr)
+        elif isinstance(e, ast.Name):
+            out.add(e.id)
+    return out
+
+
+class _ClassChecker:
+    """Field + helper-call discipline within one class."""
+
+    def __init__(
+        self, path: str, cls: ast.ClassDef, comments: Dict[int, str]
+    ) -> None:
+        self.path = path
+        self.cls = cls
+        self.comments = comments
+        self.guarded_fields: Dict[str, str] = {}  # field -> lock attr
+        self.guarded_methods: Dict[str, str] = {}  # helper -> lock attr
+        self.findings: List[Finding] = []
+        self._collect()
+
+    def _collect(self) -> None:
+        for fn in self.cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            guard = _def_line_guard(self.comments, fn)
+            if guard is not None:
+                self.guarded_methods[fn.name] = guard
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                m = comment_in_span(
+                    self.comments,
+                    stmt.lineno,
+                    getattr(stmt, "end_lineno", None),
+                    GUARDED_BY_RE,
+                )
+                if m is None:
+                    continue
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                )
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        self.guarded_fields[t.attr] = m.group(1)
+
+    def check(self) -> List[Finding]:
+        if not self.guarded_fields and not self.guarded_methods:
+            return []
+        for fn in self.cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name == "__init__" or fn.name.endswith("_locked"):
+                continue  # construction window / callee-holds convention
+            pre_held: Set[str] = set()
+            guard = _def_line_guard(self.comments, fn)
+            if guard is not None:
+                pre_held.add(guard)
+            self._walk(fn.body, pre_held, fn.name, fn)
+        return self.findings
+
+    def _walk(
+        self,
+        body: List[ast.stmt],
+        held: Set[str],
+        method: str,
+        func: ast.AST,
+    ) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.With):
+                inner = held | _with_locks(stmt)
+                self._check_exprs(stmt, held, method, stmt, header_only=True)
+                self._walk(stmt.body, inner, method, func)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # A nested closure runs later, on whatever thread calls it:
+                # it inherits no held locks (unless its own def says so).
+                nested_held: Set[str] = set()
+                guard = _def_line_guard(self.comments, stmt)
+                if guard is not None:
+                    nested_held.add(guard)
+                self._walk(stmt.body, nested_held, method, stmt)
+                continue
+            self._check_exprs(stmt, held, method, stmt, header_only=False)
+            for field_name in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field_name, None)
+                if sub:
+                    self._walk(sub, held, method, func)
+            for handler in getattr(stmt, "handlers", ()) or ():
+                self._walk(handler.body, held, method, func)
+
+    def _check_exprs(
+        self,
+        stmt: ast.stmt,
+        held: Set[str],
+        method: str,
+        span_stmt: ast.stmt,
+        header_only: bool,
+    ) -> None:
+        """Check the expressions directly attached to ``stmt`` (for
+        compound statements, only the header — children walk separately
+        with their own held sets)."""
+        nodes: List[ast.AST] = []
+        if header_only or isinstance(
+            stmt,
+            (ast.If, ast.While, ast.For, ast.AsyncFor, ast.Try, ast.With),
+        ):
+            # Header expressions only: test/iter/items — body handled in _walk.
+            for attr in ("test", "iter", "items"):
+                v = getattr(stmt, attr, None)
+                if v is None:
+                    continue
+                if attr == "items":
+                    nodes.extend(i.context_expr for i in v)
+                else:
+                    nodes.append(v)
+        else:
+            nodes.append(stmt)
+        for root in nodes:
+            for node in ast.walk(root):
+                self._check_node(node, held, method, span_stmt)
+
+    def _check_node(
+        self, node: ast.AST, held: Set[str], method: str, stmt: ast.stmt
+    ) -> None:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            lock = self.guarded_fields.get(node.attr)
+            if lock is not None and lock not in held:
+                if not _stmt_suppressed(self.comments, stmt):
+                    self.findings.append(
+                        Finding(
+                            PASS,
+                            "field-off-lock",
+                            self.path,
+                            node.lineno,
+                            f"{self.cls.name}.{node.attr}",
+                            f"access in {method}() without holding "
+                            f"self.{lock} (add `with self.{lock}:` or an "
+                            f"`# unguarded:` justification)",
+                        )
+                    )
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self"
+            ):
+                lock = self.guarded_methods.get(f.attr)
+                if lock is not None and lock not in held:
+                    if not _stmt_suppressed(self.comments, stmt):
+                        self.findings.append(
+                            Finding(
+                                PASS,
+                                "helper-off-lock",
+                                self.path,
+                                node.lineno,
+                                f"{self.cls.name}.{f.attr}",
+                                f"call from {method}() without holding "
+                                f"self.{lock} (the helper's def line says "
+                                f"it runs under that lock)",
+                            )
+                        )
+
+
+class _FunctionChecker:
+    """Function-local discipline: ``var = ...  # guarded-by: lock``."""
+
+    def __init__(
+        self, path: str, fn: ast.FunctionDef, comments: Dict[int, str]
+    ) -> None:
+        self.path = path
+        self.fn = fn
+        self.comments = comments
+        self.guarded_locals: Dict[str, str] = {}
+        self.guarded_funcs: Dict[str, str] = {}  # nested def -> lock var
+        self.findings: List[Finding] = []
+        self._collect(fn)
+
+    def _collect(self, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                guard = _def_line_guard(self.comments, node)
+                if guard is not None and node is not self.fn:
+                    self.guarded_funcs[node.name] = guard
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                m = comment_in_span(
+                    self.comments,
+                    node.lineno,
+                    getattr(node, "end_lineno", None),
+                    GUARDED_BY_RE,
+                )
+                if m is None:
+                    continue
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        self.guarded_locals[t.id] = m.group(1)
+
+    def check(self) -> List[Finding]:
+        if not self.guarded_locals:
+            return []
+        self._walk(self.fn.body, set())
+        return self.findings
+
+    def _walk(self, body: List[ast.stmt], held: Set[str]) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.With):
+                self._check_stmt_header(stmt, held)
+                self._walk(stmt.body, held | _with_locks(stmt))
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested: Set[str] = set()
+                guard = _def_line_guard(self.comments, stmt)
+                if guard is not None:
+                    nested.add(guard)
+                self._walk(stmt.body, nested)
+                continue
+            if isinstance(stmt, (ast.If, ast.While, ast.For, ast.AsyncFor, ast.Try)):
+                self._check_stmt_header(stmt, held)
+                for field_name in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field_name, None)
+                    if sub:
+                        self._walk(sub, held)
+                for handler in getattr(stmt, "handlers", ()) or ():
+                    self._walk(handler.body, held)
+                continue
+            self._check_expr(stmt, held, stmt)
+
+    def _check_stmt_header(self, stmt: ast.stmt, held: Set[str]) -> None:
+        for attr in ("test", "iter"):
+            v = getattr(stmt, attr, None)
+            if v is not None:
+                self._check_expr(v, held, stmt)
+        for item in getattr(stmt, "items", ()) or ():
+            self._check_expr(item.context_expr, held, stmt)
+
+    def _check_expr(self, root: ast.AST, held: Set[str], stmt: ast.stmt) -> None:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                lock = self.guarded_locals.get(node.id)
+                if lock is not None and lock not in held:
+                    if not _stmt_suppressed(self.comments, stmt):
+                        self.findings.append(
+                            Finding(
+                                PASS,
+                                "local-off-lock",
+                                self.path,
+                                node.lineno,
+                                f"{self.fn.name}:{node.id}",
+                                f"read of {node.id} outside `with {lock}:` "
+                                f"(annotated guarded-by at its assignment)",
+                            )
+                        )
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                lock = self.guarded_funcs.get(node.func.id)
+                if lock is not None and lock not in held:
+                    if not _stmt_suppressed(self.comments, stmt):
+                        self.findings.append(
+                            Finding(
+                                PASS,
+                                "helper-off-lock",
+                                self.path,
+                                node.lineno,
+                                f"{self.fn.name}:{node.func.id}",
+                                f"call outside `with {lock}:` (the nested "
+                                f"def's line says it runs under that lock)",
+                            )
+                        )
+
+
+def _registry_rules(
+    path: str, tree: ast.Module, annotated_classes: Set[str]
+) -> List[Finding]:
+    findings: List[Finding] = []
+    ext = {c for p, c in EXTERNAL_CLASSES if p == path}
+    internal = {c for p, c in INTERNAL_CLASSES if p == path}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        if node.name in ext:
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "threading"
+                ):
+                    findings.append(
+                        Finding(
+                            PASS,
+                            "external-grew-threading",
+                            path,
+                            sub.lineno,
+                            node.name,
+                            "externally-serialized policy class uses "
+                            "threading — it must stay lock- and thread-free "
+                            "(the serve event lock is its only discipline)",
+                        )
+                    )
+        if node.name in internal and node.name not in annotated_classes:
+            findings.append(
+                Finding(
+                    PASS,
+                    "lock-unannotated",
+                    path,
+                    node.lineno,
+                    node.name,
+                    "internally-locked class has no `# guarded-by:` field "
+                    "annotations left — the discipline surface rotted away",
+                )
+            )
+    return findings
+
+
+def run(root: Path, scan_dirs: Optional[Tuple[str, ...]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(root, scan_dirs):
+        try:
+            source = path.read_text()
+            tree = ast.parse(source)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            findings.append(
+                Finding(PASS, "parse-error", rel(path, root), 1, path.name, str(e))
+            )
+            continue
+        comments = file_comments(source)
+        rpath = rel(path, root)
+        annotated: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                checker = _ClassChecker(rpath, node, comments)
+                if checker.guarded_fields:
+                    annotated.add(node.name)
+                findings.extend(checker.check())
+        for node in tree.body:  # module-level functions only (serve, main)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(_FunctionChecker(rpath, node, comments).check())
+        findings.extend(_registry_rules(rpath, tree, annotated))
+    return findings

@@ -1,0 +1,46 @@
+"""The guarded-surface registry: which objects the thread-discipline
+machinery (static ``lock`` pass + runtime sanitizer) watches, and how.
+
+Two disciplines exist in this codebase:
+
+- ``external``: pure policy objects with NO internal locking, serialized
+  by their owner's event lock (apps/server.serve's ``lock``).  The static
+  pass verifies they stay lock- and thread-free inside; the runtime
+  sanitizer (utils/sanitize.guard) wraps their instances in serve() and
+  raises on any off-lock access once shared.  The serve-loop locals that
+  hold them are annotated ``# guarded-by: lock`` at their assignment.
+- ``internal``: objects that own a lock and take it themselves; their
+  fields carry ``# guarded-by: <lockattr>`` annotations and the static
+  pass enforces every access happens under ``with self.<lockattr>:`` (or
+  in a helper annotated/`` _locked``-suffixed as called-under-lock).
+"""
+
+from __future__ import annotations
+
+#: Externally-serialized policy classes: (module path, class name).
+#: The static pass fails if any of these grows a ``threading.`` dependency
+#: (an externally-serialized object must never sprout its own threads or
+#: locks — that is how two lock disciplines start to interleave).
+EXTERNAL_CLASSES = (
+    ("bitcoin_miner_tpu/apps/scheduler.py", "Scheduler"),
+    ("bitcoin_miner_tpu/gateway/core.py", "Gateway"),
+    ("bitcoin_miner_tpu/gateway/cache.py", "ResultCache"),
+    ("bitcoin_miner_tpu/gateway/admission.py", "FairQueue"),
+    ("bitcoin_miner_tpu/gateway/admission.py", "TokenBucket"),
+    ("bitcoin_miner_tpu/utils/wfq.py", "VirtualClockWFQ"),
+)
+
+#: Internally-locked classes expected to carry ``# guarded-by:`` field
+#: annotations.  The static pass warns (rule ``lock-unannotated``) if one
+#: of these classes has no annotated fields at all — the annotation set
+#: must not silently rot away in a refactor.
+INTERNAL_CLASSES = (
+    ("bitcoin_miner_tpu/utils/metrics.py", "Metrics"),
+    ("bitcoin_miner_tpu/utils/metrics.py", "RateMeter"),
+    ("bitcoin_miner_tpu/lspnet/chaos.py", "NetSim"),
+)
+
+#: Functions whose locals carry ``# guarded-by: <lockvar>`` annotations
+#: (the serve-loop discipline).  Informational — the static pass discovers
+#: annotations wherever they appear; this names the load-bearing one.
+ANNOTATED_FUNCTIONS = (("bitcoin_miner_tpu/apps/server.py", "serve"),)

@@ -1,0 +1,162 @@
+"""Pass: the race-sanitizer machinery check (the runtime leg).
+
+``BMT_SANITIZE=1`` is a *runtime* tool — it finds races while the chaos
+soak and gateway suites actually run (tests/test_analyze.py wires it into
+both).  What a static analyzer run can and does verify, in milliseconds:
+
+- **Repo mode**: the machinery itself works end to end — a TrackedLock +
+  Monitor around a real ``Scheduler`` driven correctly from two threads
+  is silent; the same setup driven with a deliberate off-lock access and
+  an ABBA acquisition raises.  A sanitizer that cannot detect is worse
+  than none (green soaks would certify nothing), so "failed to fire" is
+  itself a finding.
+- **Fixture mode** (a ``bad_race.py`` under ``--root``): import it and
+  run each ``provoke_*()``; every RaceError / LockOrderError raised is
+  reported as a finding — the seeded violation demonstrably fires, and
+  the CLI exits non-zero on it.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import threading
+from pathlib import Path
+from typing import Any, List, Optional, Tuple
+
+from .common import Finding, rel
+
+PASS = "sanitize"
+
+
+def _load_module(path: Path) -> Any:
+    spec = importlib.util.spec_from_file_location(path.stem, path)
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _machinery_selftest() -> List[Finding]:
+    """Repo mode: the sanitizer must be quiet on disciplined use and loud
+    on violations, against the real guarded classes."""
+    from bitcoin_miner_tpu.apps.scheduler import Scheduler
+    from bitcoin_miner_tpu.utils import sanitize
+
+    findings: List[Finding] = []
+    path = "bitcoin_miner_tpu/utils/sanitize.py"
+    sanitize.force(True)
+    try:
+        sanitize.reset_order_graph()
+        lock = sanitize.make_lock("analyze.selftest")
+        sched = sanitize.guard(Scheduler(), lock, "scheduler")
+        errors: List[BaseException] = []
+
+        def disciplined() -> None:
+            try:
+                for i in range(50):
+                    with lock:
+                        sched.stats()
+            except BaseException as e:  # noqa: BLE001 — report, don't die
+                errors.append(e)
+
+        threads = [threading.Thread(target=disciplined) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            findings.append(
+                Finding(
+                    PASS,
+                    "false-positive",
+                    path,
+                    1,
+                    "Monitor",
+                    f"sanitizer raised on correctly-locked access: "
+                    f"{errors[0]!r}",
+                )
+            )
+        # Detection leg: an off-lock access after sharing MUST raise.
+        try:
+            sched.stats()
+            findings.append(
+                Finding(
+                    PASS,
+                    "failed-to-fire",
+                    path,
+                    1,
+                    "Monitor",
+                    "off-lock access to a shared guarded object did not "
+                    "raise RaceError — the sanitizer is blind",
+                )
+            )
+        except sanitize.RaceError:
+            pass
+        # Lock-order leg: ABBA must raise deterministically.
+        sanitize.reset_order_graph()
+        a = sanitize.TrackedLock("analyze.A")
+        b = sanitize.TrackedLock("analyze.B")
+        with a:
+            with b:
+                pass
+        try:
+            with b:
+                with a:
+                    pass
+            findings.append(
+                Finding(
+                    PASS,
+                    "failed-to-fire",
+                    path,
+                    1,
+                    "TrackedLock",
+                    "ABBA acquisition did not raise LockOrderError",
+                )
+            )
+        except sanitize.LockOrderError:
+            pass
+    finally:
+        sanitize.force(None)
+        sanitize.reset_order_graph()
+    return findings
+
+
+def run(root: Path, scan_dirs: Optional[Tuple[str, ...]] = None) -> List[Finding]:
+    from bitcoin_miner_tpu.utils import sanitize
+
+    # Fixture mode only applies when scanning an explicit --root tree;
+    # repo mode (scan_dirs set) must not trip over the checked-in fixtures.
+    fixture = None
+    if scan_dirs is None and root.is_dir():
+        for c in [root / "bad_race.py", *sorted(root.rglob("bad_race.py"))]:
+            if c.exists() and "__pycache__" not in c.parts:
+                fixture = c
+                break
+    if fixture is None:
+        return _machinery_selftest()
+
+    findings: List[Finding] = []
+    mod = _load_module(fixture)
+    sanitize.force(True)
+    try:
+        sanitize.reset_order_graph()
+        for name in dir(mod):
+            if not name.startswith("provoke"):
+                continue
+            try:
+                getattr(mod, name)()
+            except (sanitize.RaceError, sanitize.LockOrderError) as e:
+                findings.append(
+                    Finding(
+                        PASS,
+                        "race-detected",
+                        rel(fixture, root),
+                        1,
+                        name,
+                        f"{type(e).__name__}: {e}",
+                    )
+                )
+    finally:
+        sanitize.force(None)
+        sanitize.reset_order_graph()
+    return findings

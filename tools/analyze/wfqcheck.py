@@ -1,0 +1,92 @@
+"""Pass 2: the single-WFQ rule.
+
+Exactly one virtual-clock WFQ implementation may exist —
+``bitcoin_miner_tpu/utils/wfq.py``.  The correctness surface of that
+discipline is two small idioms that history shows get copy-pasted and
+then drift:
+
+- **floor init**: ``min((p.vt for p in ... if p.items), default=0.0)`` —
+  a new principal starting anywhere else either starves or is starved;
+- **tie-break**: comparing ``(vt, seq)`` tuples — dropping ``seq`` makes
+  selection nondeterministic across dict orders.
+
+This pass flags any module outside utils/wfq.py that contains either
+idiom: a ``min()``/``max()`` call with a ``default=`` keyword whose
+arguments reach a ``.vt`` attribute, or a comparison between tuples
+mentioning both ``.vt`` and ``.seq``.  Reuse the primitive instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from .common import Finding, iter_py_files, rel
+
+PASS = "wfq"
+
+#: The one sanctioned home of the discipline.
+CANONICAL = "bitcoin_miner_tpu/utils/wfq.py"
+
+
+def _mentions_attr(node: ast.AST, attr: str) -> bool:
+    return any(
+        isinstance(n, ast.Attribute) and n.attr == attr for n in ast.walk(node)
+    )
+
+
+def _check_tree(path: str, tree: ast.Module) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("min", "max")
+            and any(kw.arg == "default" for kw in node.keywords)
+            and _mentions_attr(node, "vt")
+        ):
+            findings.append(
+                Finding(
+                    PASS,
+                    "floor-init-reimplemented",
+                    path,
+                    node.lineno,
+                    node.func.id,
+                    "virtual-time floor computation outside utils/wfq.py — "
+                    "use VirtualClockWFQ.add (the one copy of the rule)",
+                )
+            )
+        if isinstance(node, ast.Compare):
+            sides = [node.left, *node.comparators]
+            tuple_sides = [s for s in sides if isinstance(s, ast.Tuple)]
+            if any(
+                _mentions_attr(s, "vt") and _mentions_attr(s, "seq")
+                for s in tuple_sides
+            ):
+                findings.append(
+                    Finding(
+                        PASS,
+                        "tiebreak-reimplemented",
+                        path,
+                        node.lineno,
+                        "(vt, seq)",
+                        "virtual-clock tie-break comparison outside "
+                        "utils/wfq.py — use VirtualClockWFQ.select/pop",
+                    )
+                )
+    return findings
+
+
+def run(root: Path, scan_dirs: Optional[Tuple[str, ...]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(root, scan_dirs):
+        rpath = rel(path, root)
+        if rpath == CANONICAL:
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except (SyntaxError, UnicodeDecodeError):
+            continue  # the lock pass reports parse errors once
+        findings.extend(_check_tree(rpath, tree))
+    return findings
