@@ -17,10 +17,12 @@ from ..utils.metrics import METRICS
 
 
 def request_once(
-    client: "lsp.Client", message: str, max_nonce: int
+    client: "lsp.Client", message: str, max_nonce: int, lower: int = 0
 ) -> Optional[Tuple[int, int]]:
-    """Send the job and block for its Result; None if the conn is lost."""
-    client.write(Message.request(message, 0, max_nonce).marshal())
+    """Send the job and block for its Result; None if the conn is lost.
+    The CLI's frozen shape is ``[lower=0, max_nonce]``; in-process callers
+    (tools/loadgen.py's overlap workload) may sweep an interior range."""
+    client.write(Message.request(message, lower, max_nonce).marshal())
     while True:
         try:
             payload = client.read()

@@ -69,6 +69,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from ..bitcoin.hash import hash_nonce
 from ..bitcoin.message import Message
+from ..utils.intervals import intersect_intervals, merge_intervals
 from ..utils.metrics import METRICS
 from ..utils.wfq import VirtualClockWFQ
 
@@ -167,6 +168,8 @@ class Scheduler:
         pipeline_depth: int = 2,
         ramp_factor: int = 8,
         orphan_cache_max: int = 256,
+        record_spans: bool = False,
+        span_export_max: int = 4096,
         resume_state: Optional[dict] = None,
     ) -> None:
         if pipeline_depth < 1:
@@ -182,6 +185,14 @@ class Scheduler:
         self.pipeline_depth = pipeline_depth
         self.ramp_factor = ramp_factor
         self.orphan_cache_max = orphan_cache_max
+        # Span export (ISSUE 5): with record_spans on, every accepted chunk
+        # Result is also published as a solved span (data, lo, hi, hash,
+        # nonce) for the gateway's interval store — the chunk minimum IS
+        # the span fold.  Bounded: overflow drops oldest (a lost span only
+        # costs reuse, never correctness).
+        self.record_spans = record_spans
+        self.span_export_max = max(1, span_export_max)
+        self._span_export: List[Tuple[str, int, int, int, int]] = []
         self.miners: Dict[int, _Miner] = {}
         self.jobs: Dict[int, _Job] = {}
         # WFQ principals (see _next_job): the shared virtual-clock
@@ -218,10 +229,21 @@ class Scheduler:
         now: float = 0.0,
         tenant: Optional[str] = None,
         weight: float = 1.0,
+        gaps: Optional[List[Interval]] = None,
+        seed_best: Optional[Tuple[int, int]] = None,
     ) -> List[Action]:
         """``tenant``/``weight`` name the fair-queue principal this job is
         charged to (the gateway passes its per-client key); default is the
-        conn itself, i.e. every job its own equal-share tenant."""
+        conn itself, i.e. every job its own equal-share tenant.
+
+        ``gaps``/``seed_best`` are the gateway's remainder-job interface
+        (ISSUE 5): sweep only the ``gaps`` sub-intervals of ``[lower,
+        upper]`` and fold ``seed_best`` — the already-known minimum over
+        the covered complement — into the job at birth.  Because the seed
+        rides ``job.best``, the emitted Result AND the checkpoint identity
+        stay whole-range-correct: an orphaned gap job stashes ``(best,
+        remaining)`` under ``(data, lower, upper)`` exactly like a
+        full-range job, so any later twin resumes it soundly."""
         self.revision += 1
         if conn_id in self.jobs or conn_id in self.miners:
             return []  # one job per client conn; ignore repeats
@@ -231,15 +253,26 @@ class Scheduler:
             client_id=conn_id, data=data, lower=lower, upper=upper,
             tenant=tenant or f"conn:{conn_id}",
         )
+        if seed_best is not None:
+            job.fold(seed_best[0], seed_best[1])
+        base: List[Interval] = [(lower, upper)] if lower <= upper else []
+        if gaps is not None:
+            # The caller vouches its seed folds everything OUTSIDE the
+            # gaps; clamp to the job range so a buggy gap list can never
+            # sweep beyond the requested signature.
+            base = intersect_intervals(base, list(gaps))
         resumed = self._resume.pop(job.key, None)
         if resumed is not None:
             best, remaining = resumed
-            job.best = best
-            job.pending.extend(remaining)
+            if best is not None:
+                job.fold(best[0], best[1])
+            # Two independent "still unswept" snapshots meet: a nonce needs
+            # sweeping only if BOTH say so — each side's complement is
+            # already folded into job.best (stash best / gateway seed).
+            base = intersect_intervals(base, remaining)
             METRICS.inc("sched.jobs_resumed")
-        elif lower <= upper:
-            job.pending.append((lower, upper))
-        if job.done:  # empty range, or checkpoint says fully swept
+        job.pending.extend(base)
+        if job.done:  # empty range, or checkpoint/seed says fully swept
             best = job.best or (0, 0)
             return [(conn_id, Message.result(best[0], best[1]))]
         self.jobs[conn_id] = job
@@ -287,6 +320,14 @@ class Scheduler:
             nxt.started_at = max(nxt.started_at, now)
         actions: List[Action] = []
         if job is not None:
+            if self.record_spans and lo <= nonce <= hi:
+                # Publish the chunk as a solved span for the gateway's
+                # interval store.  The in-range check matters only with
+                # validation off: an out-of-range argmin is no evidence
+                # about [lo, hi] and would poison cross-job reuse.
+                self._span_export.append((job.data, lo, hi, hash_, nonce))
+                if len(self._span_export) > self.span_export_max:
+                    del self._span_export[0]
             job.remove_outstanding(conn_id, front.interval)
             if front.timed_out:
                 # The slow miner finished after all: withdraw whatever of
@@ -591,6 +632,12 @@ class Scheduler:
         out, self._evicted = self._evicted, []
         return out
 
+    def drain_spans(self) -> List[Tuple[str, int, int, int, int]]:
+        """Solved chunk spans accepted since the last drain (empty unless
+        ``record_spans``); the gateway feeds them to its interval store."""
+        out, self._span_export = self._span_export, []
+        return out
+
     # ------------------------------------------------------------------ metrics
 
     def stats(self) -> Dict[str, int]:
@@ -644,13 +691,6 @@ def _merge_progress(
     into[key] = (best, _merge_intervals(list(remaining)))
 
 
-def _merge_intervals(intervals: List[Interval]) -> List[Interval]:
-    """Coalesce overlapping/adjacent inclusive intervals (checkpoint hygiene:
-    straggler duplicates must not double-count work on resume)."""
-    out: List[Interval] = []
-    for lo, hi in sorted(intervals):
-        if out and lo <= out[-1][1] + 1:
-            out[-1] = (out[-1][0], max(out[-1][1], hi))
-        else:
-            out.append((lo, hi))
-    return out
+# The coalescing rule now lives in utils/intervals.py (the gateway's span
+# store runs the same one); this name stays as the API tests import.
+_merge_intervals = merge_intervals

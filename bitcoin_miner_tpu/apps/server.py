@@ -63,12 +63,24 @@ def serve(
     # the checkpoint — never on the per-job event path.
     cache = getattr(sched, "cache", None)  # guarded-by: lock; unguarded: setup, ticker not started
     cache_path = getattr(cache, "path", None)  # unguarded: setup, and path is immutable
+    # A gateway engine accepts a per-request client identity: bind its
+    # token buckets / fair-queue keys to the LSP peer address, which is
+    # stable across reconnects (the conn id and UDP source port are not).
+    accepts_client_key = cache is not None  # unguarded: setup; only Gateway carries a cache
+    peer_host = getattr(server, "peer_host", None)  # transports without peer identity: per-conn keys
+    # The interval-algebra span store rides the same dirty-flag flush
+    # cadence as the result cache (ISSUE 5).
+    spans = getattr(sched, "spans", None)  # guarded-by: lock; unguarded: setup, ticker not started
+    spans_path = getattr(spans, "path", None)  # unguarded: setup, and path is immutable
     if cache_path is None:
         cache = None  # in-memory only: nothing to flush  # unguarded: setup
+    if spans_path is None:
+        spans = None  # in-memory only: nothing to flush  # unguarded: setup
     # Race sanitizer (BMT_SANITIZE=1): every access to the policy objects
     # off this lock raises once the ticker shares them (utils/sanitize.py).
     sched = sanitize.guard(sched, lock, "scheduler")  # unguarded: setup
     cache = sanitize.guard(cache, lock, "result-cache") if cache is not None else None  # unguarded: setup
+    spans = sanitize.guard(spans, lock, "span-store") if spans is not None else None  # unguarded: setup
     # Operator health surface (the reference's LOGF scaffold,
     # bitcoin/server/server.go:26-39, implies exactly this): periodic
     # scheduler stats + recovery counters in log.txt, so reassignment/
@@ -145,6 +157,7 @@ def serve(
                         else None
                     )
                     cache_state = cache.flush() if cache is not None else None
+                    spans_state = spans.flush() if spans is not None else None
                     line = (
                         health_line() if ticks % health_every == 0 else None
                     )
@@ -154,20 +167,33 @@ def serve(
                 if actions:
                     log.info("straggler tick reclaimed work")
                     emit(actions)
+                # Each artifact's save is independent: one failing disk
+                # write must not discard another's already-flushed state
+                # (flush() cleared its dirty flag — dropping the snapshot
+                # here would lose it until some future mutation re-dirties
+                # the store).  Failures re-arm their own retry and nothing
+                # else: checkpoint by not advancing saved_rev, the stores
+                # by mark_dirty (the only-advance-on-success contract).
                 if state is not None:
-                    save_checkpoint(checkpoint_path, state)
-                    saved_rev = rev
+                    try:
+                        save_checkpoint(checkpoint_path, state)
+                        saved_rev = rev
+                    except Exception:
+                        log.exception("checkpoint save failed; will retry")
                 if cache_state is not None:
                     try:
                         save_checkpoint(cache_path, cache_state)
                     except Exception:
-                        # Re-arm so the NEXT tick retries even if no new
-                        # result dirties the cache meanwhile (the
-                        # checkpoint's only-advance-saved_rev-on-success
-                        # contract, in dirty-flag form).
                         with lock:
                             cache.mark_dirty()
-                        raise
+                        log.exception("result-cache flush failed; will retry")
+                if spans_state is not None:
+                    try:
+                        save_checkpoint(spans_path, spans_state)
+                    except Exception:
+                        with lock:
+                            spans.mark_dirty()
+                        log.exception("span-store flush failed; will retry")
             except Exception:
                 # A transient failure (e.g. checkpoint disk full) must not
                 # silently kill straggler recovery for the server's lifetime.
@@ -193,6 +219,15 @@ def serve(
                 log.warning("undecodable payload from %d", conn_id)
                 continue
             now = clock()
+            # Resolve the admission identity BEFORE taking the event lock
+            # (peer_host crosses into the transport's loop thread).  Keyed
+            # by remote host, not conn id: a client that reconnects keeps
+            # draining the same token bucket instead of minting a fresh
+            # burst allowance per conn.
+            peer_key = None
+            if accepts_client_key and msg.type == MsgType.REQUEST and peer_host is not None:
+                host = peer_host(conn_id)
+                peer_key = f"addr:{host}" if host else None
             with lock:
                 if msg.type == MsgType.JOIN:
                     log.info("miner %d joined; %s", conn_id, sched.stats())
@@ -202,9 +237,15 @@ def serve(
                         "request from %d: data=%r range=[%d,%d]",
                         conn_id, msg.data, msg.lower, msg.upper,
                     )
-                    actions = sched.client_request(
-                        conn_id, msg.data, msg.lower, msg.upper, now
-                    )
+                    if peer_key is not None:
+                        actions = sched.client_request(
+                            conn_id, msg.data, msg.lower, msg.upper, now,
+                            client_key=peer_key,
+                        )
+                    else:
+                        actions = sched.client_request(
+                            conn_id, msg.data, msg.lower, msg.upper, now
+                        )
                 elif msg.type == MsgType.RESULT:
                     actions = sched.result(conn_id, msg.hash, msg.nonce, now)
                 else:
@@ -232,6 +273,14 @@ def serve(
                     save_checkpoint(cache_path, cache_state)
                 except OSError:
                     log.exception("final result-cache flush failed")
+        if spans is not None:  # unguarded: reads the binding, not the object
+            with lock:  # same shutdown contract as the result cache
+                spans_state = spans.flush()
+            if spans_state is not None:
+                try:
+                    save_checkpoint(spans_path, spans_state)
+                except OSError:
+                    log.exception("final span-store flush failed")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -243,12 +292,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         format="%(asctime)s %(filename)s:%(lineno)d %(message)s",
     )
     # Beyond-parity flags (same idiom as --checkpoint=FILE): --gateway arms
-    # the serving layer (coalescing + result cache + admission control);
-    # --cache=FILE persists the result cache (implies --gateway); --rate /
-    # --burst / --max-queued tune admission (README "Serving gateway").
+    # the serving layer (coalescing + result cache + interval span store +
+    # admission control); --cache=FILE / --spans=FILE persist the result
+    # cache / span store (either implies --gateway); --rate / --burst /
+    # --max-queued tune admission (README "Serving gateway").
     checkpoint_path = None
     gateway_on = False
     cache_path = None
+    spans_path = None
     rate: Optional[float] = 5.0
     burst = 10.0
     max_queued = 256
@@ -261,6 +312,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif a.startswith("--cache="):
             gateway_on = True
             cache_path = a.split("=", 1)[1]
+        elif a.startswith("--spans="):
+            gateway_on = True
+            spans_path = a.split("=", 1)[1]
         elif a.startswith(("--rate=", "--burst=", "--max-queued=")):
             gateway_on = True  # admission knobs imply the gateway, like --cache
             name, _, val = a.partition("=")
@@ -307,11 +361,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     resume = load_checkpoint(checkpoint_path) if checkpoint_path else None
     sched = Scheduler(resume_state=resume)
     if gateway_on:
-        from ..gateway import Gateway, ResultCache
+        from ..gateway import Gateway, ResultCache, SpanStore
 
         sched = Gateway(
             sched,
             cache=ResultCache(path=cache_path),
+            spans=SpanStore(path=spans_path),
             rate=rate,
             burst=burst,
             max_queued=max_queued,

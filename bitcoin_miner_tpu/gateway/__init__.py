@@ -2,13 +2,14 @@
 
 The scheduler answers "how do I split this job across miners"; the gateway
 answers "which of the requests hammering the door should become jobs at
-all" — request coalescing, a content-addressed result cache, and admission
-control (token buckets + fair queueing + load shedding).  See
+all" — request coalescing, a content-addressed result cache plus the
+interval-algebra span store (sub-range answers from solved spans), and
+admission control (token buckets + fair queueing + load shedding).  See
 :mod:`.core` for the full design notes.
 """
 
 from .admission import FairQueue, TokenBucket
-from .cache import ResultCache
+from .cache import ResultCache, SpanStore
 from .core import Gateway
 
-__all__ = ["FairQueue", "Gateway", "ResultCache", "TokenBucket"]
+__all__ = ["FairQueue", "Gateway", "ResultCache", "SpanStore", "TokenBucket"]

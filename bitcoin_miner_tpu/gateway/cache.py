@@ -1,11 +1,23 @@
-"""Content-addressed result cache — solved jobs answer without device work.
+"""Result stores — solved work answers without device work.
 
-The argmin over a fixed ``(data, lower, upper)`` range is a pure function,
-so a completed job's ``(hash, nonce)`` is cacheable forever under that
-signature — the same identity the scheduler's checkpoint/orphan resume
-machinery already keys on.  The gateway consults this cache before
-anything touches the scheduler: a repeat of a solved job costs one
-dictionary lookup and one Result send, zero chunks assigned.
+Two stores, two granularities:
+
+- :class:`ResultCache` — exact-signature LRU: the argmin over a fixed
+  ``(data, lower, upper)`` range is a pure function, so a completed job's
+  ``(hash, nonce)`` is cacheable forever under that signature — the same
+  identity the scheduler's checkpoint/orphan resume machinery keys on.
+- :class:`SpanStore` (ISSUE 5) — the interval-algebra result store: as
+  *chunks* complete, their ``[lo, hi] -> (min_hash, nonce)`` folds land
+  in a per-data :class:`~bitcoin_miner_tpu.utils.intervals.IntervalMap`.
+  A new request is planned against the solved spans (``cover``): fully
+  covered ranges answer by folding span minima with zero device work
+  (``gateway.span_hits``); partially covered ranges sweep only the
+  uncovered gaps as a remainder job.  LRU over data keys bounds memory;
+  each map's span budget coalesces adjacent spans under pressure.
+
+The gateway consults both before anything touches the scheduler: a repeat
+of a solved job — or any sub-range the fleet has already hashed — costs
+dictionary lookups and one Result send, zero chunks assigned.
 
 In-memory LRU with optional disk persistence through the shared atomic
 temp-write + rename path (utils/persist.py — the same torn-write contract
@@ -24,8 +36,9 @@ alongside the scheduler's partial-progress checkpoint.  Evictions bump
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
+from ..utils.intervals import Interval, IntervalMap
 from ..utils.metrics import METRICS
 from ..utils.persist import load_json, save_json_atomic
 
@@ -113,3 +126,120 @@ class ResultCache:
             self._entries[(data, lower, upper)] = (h, n)
         while len(self._entries) > self.capacity:
             self._entries.popitem(last=False)
+
+
+class SpanStore:
+    """Per-data interval maps of solved spans (see module docstring).
+
+    ``capacity`` bounds the number of *data keys* (LRU eviction,
+    ``gateway.span_evictions``); ``max_spans_per_data`` is each map's
+    span budget (adjacent-coalesce under pressure).  ``capacity=0``
+    disables the store entirely (every ``cover`` reports the whole query
+    as a gap) — the exact-match-cache-only comparison leg.  ``path`` arms
+    disk persistence through the same dirty-flag + atomic-write contract
+    as :class:`ResultCache` (flushed by ``serve()``'s ticker).  Not
+    thread-safe by itself — the gateway serializes access under the
+    server shell's event lock, like every other policy structure."""
+
+    def __init__(
+        self,
+        capacity: int = 512,
+        max_spans_per_data: int = 64,
+        path: Optional[str] = None,
+    ) -> None:
+        self.capacity = max(0, int(capacity))
+        self.max_spans_per_data = max(1, int(max_spans_per_data))
+        self.path = path
+        self._maps: "OrderedDict[str, IntervalMap]" = OrderedDict()
+        self._dirty = False
+        if path is not None:
+            self._load(path)
+
+    def __len__(self) -> int:
+        """Total solved spans across every data key."""
+        return sum(len(m) for m in self._maps.values())
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    def data_count(self) -> int:
+        return len(self._maps)
+
+    def add(self, data: str, lo: int, hi: int, hash_: int, nonce: int) -> None:
+        if self.capacity == 0:
+            return
+        m = self._maps.get(data)
+        if m is None:
+            m = self._maps[data] = IntervalMap(self.max_spans_per_data)
+        self._maps.move_to_end(data)  # LRU freshness
+        m.add(lo, hi, hash_, nonce)
+        while len(self._maps) > self.capacity:
+            self._maps.popitem(last=False)
+            METRICS.inc("gateway.span_evictions")
+        self._dirty = True
+
+    def cover(
+        self, data: str, lo: int, hi: int
+    ) -> Tuple[Optional[Tuple[int, int]], List[Interval]]:
+        """Plan ``[lo, hi]`` against ``data``'s solved spans:
+        ``(folded best over answerable portions, uncovered gaps)`` — see
+        :meth:`IntervalMap.cover` for the answerability rule."""
+        m = self._maps.get(data)
+        if m is None:
+            return None, ([(lo, hi)] if lo <= hi else [])
+        self._maps.move_to_end(data)
+        return m.cover(lo, hi)
+
+    # ------------------------------------------------------------ persistence
+
+    def _serialize(self) -> dict:
+        return {
+            "version": 1,
+            # LRU order (oldest first) so a reload evicts the same way.
+            "data": [
+                [data, [list(s) for s in m.spans()]]
+                for data, m in self._maps.items()
+            ],
+        }
+
+    def flush(self) -> Optional[dict]:
+        """Same contract as :meth:`ResultCache.flush`: the serializable
+        state if dirty (clears the flag), else None; the shell writes it
+        outside the event lock and re-arms the flag on failure."""
+        if not self._dirty:
+            return None
+        self._dirty = False
+        return self._serialize()
+
+    def mark_dirty(self) -> None:
+        self._dirty = True
+
+    def save(self, path: str) -> None:
+        self._dirty = False
+        save_json_atomic(path, self._serialize())
+
+    def _load(self, path: str) -> None:
+        state = load_json(path)
+        if state is None:
+            return  # missing/torn file: start empty (same as checkpoint)
+        for entry in state.get("data", ()):
+            try:
+                data, rows = entry
+            except (TypeError, ValueError):
+                continue  # one bad row must not poison the rest
+            if not (isinstance(data, str) and isinstance(rows, list)):
+                continue
+            for row in rows:
+                try:
+                    lo, hi, h, n = row
+                except (TypeError, ValueError):
+                    continue
+                if not all(
+                    isinstance(v, int) and not isinstance(v, bool)
+                    for v in (lo, hi, h, n)
+                ):
+                    continue
+                # add() re-validates span shape and restores disjointness.
+                self.add(data, lo, hi, h, n)
+        self._dirty = False  # a fresh load is already on disk

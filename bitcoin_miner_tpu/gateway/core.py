@@ -13,6 +13,16 @@ What it adds, in the order a request meets it:
 1. **Content-addressed result cache** (:class:`ResultCache`): the argmin
    over ``(data, lower, upper)`` is pure, so a solved signature answers in
    one round-trip with zero device work (``gateway.cache_hits``).
+   Behind it sits the **interval-algebra result store**
+   (:class:`SpanStore`, ISSUE 5): every completed *chunk* is recorded as
+   a solved span, and a coverage planner intersects each new request with
+   the solved spans — a fully covered range answers by folding span
+   minima, zero device work (``gateway.span_hits``); a partially covered
+   range submits only the uncovered gaps as a remainder job, seeding the
+   scheduler with the covered portions' fold so the single Result (and
+   the checkpoint identity) stays whole-range-correct
+   (``gateway.span_partial``; nonces skipped either way count into
+   ``gateway.nonces_saved``).
 2. **Request coalescing**: concurrent Requests with the same signature
    share ONE underlying sweep.  The gateway submits each distinct
    signature to the scheduler under a *virtual* client id (negative, so it
@@ -38,11 +48,12 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from ..apps.scheduler import Action, JobKey, Scheduler
+from ..apps.scheduler import Action, Interval, JobKey, Scheduler
 from ..bitcoin.message import Message, MsgType
+from ..utils.intervals import interval_total
 from ..utils.metrics import METRICS
 from .admission import FairQueue, TokenBucket
-from .cache import ResultCache
+from .cache import ResultCache, SpanStore
 
 
 @dataclass
@@ -68,6 +79,7 @@ class Gateway:
         scheduler: Optional[Scheduler] = None,
         *,
         cache: Optional[ResultCache] = None,
+        spans: Optional[SpanStore] = None,
         rate: Optional[float] = 5.0,
         burst: float = 10.0,
         max_active: int = 64,
@@ -76,6 +88,12 @@ class Gateway:
     ) -> None:
         self.sched = scheduler if scheduler is not None else Scheduler()
         self.cache = cache if cache is not None else ResultCache()
+        # The interval store is on by default (pass SpanStore(capacity=0)
+        # for an exact-match-cache-only gateway, e.g. the loadgen
+        # comparison leg); arming it turns on the scheduler's span export.
+        self.spans = spans if spans is not None else SpanStore()
+        if self.spans.enabled:
+            self.sched.record_spans = True
         self.rate = rate  # per-client requests/sec; None = unlimited
         self.burst = burst
         self.max_active = max(1, max_active)
@@ -106,6 +124,11 @@ class Gateway:
         self, conn_id: int, hash_: int, nonce: int, now: float = 0.0
     ) -> List[Action]:
         out = self._translate(self.sched.result(conn_id, hash_, nonce, now), now)
+        # Record freshly solved chunk spans BEFORE draining the backlog:
+        # a queued request admitted by this very completion should already
+        # see them (it may now be fully covered).
+        for data, lo, hi, h, n in self.sched.drain_spans():
+            self.spans.add(data, lo, hi, h, n)
         out.extend(self._admit(now))  # a completion may have freed capacity
         return out
 
@@ -144,6 +167,18 @@ class Gateway:
         if hit is not None:
             METRICS.inc("gateway.cache_hits")
             return [(conn_id, Message.result(hit[0], hit[1]))]
+        # 1b. Never seen this exact signature, but the solved spans may
+        # cover it whole (a sub-range of swept work) — answer by folding
+        # span minima, before admission: a zero-work answer should cost
+        # neither a token nor an active slot.  The plan is computed once
+        # and threaded into _submit for the partial-coverage case; a
+        # request that ends up QUEUED instead replans at admit time.
+        plan = None
+        if lower <= upper:
+            plan = self.spans.cover(data, lower, upper)
+            answer = self._span_answer(conn_id, key, plan)
+            if answer is not None:
+                return [answer]
         # 2. Already sweeping: join the waiter list, share the one sweep.
         flight = self._by_key.get(key)
         if flight is not None:
@@ -170,7 +205,7 @@ class Gateway:
             self._queue.push(ckey, (conn_id, key, ckey))
             self._queued_conns.add(conn_id)
             return []
-        return self._submit(conn_id, key, ckey, now)
+        return self._submit(conn_id, key, ckey, now, plan=plan)
 
     def lost(self, conn_id: int, now: float = 0.0) -> List[Action]:
         key = self._conn_key.pop(conn_id, None)
@@ -224,6 +259,7 @@ class Gateway:
             gw_waiters=len(self._conn_key),
             gw_queued=len(self._queue),
             gw_cached=len(self.cache),
+            gw_spans=len(self.spans),
         )
         return st
 
@@ -253,11 +289,42 @@ class Gateway:
         return bucket.try_take(now)
 
     def _submit(
-        self, conn_id: int, key: JobKey, client_key: str, now: float
+        self,
+        conn_id: int,
+        key: JobKey,
+        client_key: str,
+        now: float,
+        plan: Optional[Tuple[Optional[Tuple[int, int]], List[Interval]]] = None,
     ) -> List[Action]:
         """Dispatch a fresh signature into the scheduler under a virtual id
         (tenant = the client key, so the scheduler's WFQ shares nonce
-        throughput per client, not per job)."""
+        throughput per client, not per job).
+
+        ``plan`` is the caller's already-computed ``cover()`` result
+        (client_request threads it so the hot path plans once); without
+        one — the admit-from-queue path — coverage is planned here, so a
+        request that waited sees every span solved while it was parked.
+        Partial coverage submits only the uncovered gaps, seeding the
+        scheduler with the covered portions' fold so its Result — and its
+        checkpoint identity under ``(data, lower, upper)`` — is the whole
+        range's answer.  Full coverage never normally reaches here
+        (client_request answers it pre-admission, _resolve_twin catches
+        queued twins); if it ever did, the empty gap list makes the
+        scheduler's job done at birth and the seed fans out through the
+        normal path — correct either way."""
+        data, lower, upper = key
+        gaps: Optional[List[Interval]] = None
+        seed: Optional[Tuple[int, int]] = None
+        if lower <= upper:
+            seed, gaps = (
+                plan if plan is not None else self.spans.cover(data, lower, upper)
+            )
+            saved = (upper - lower + 1) - interval_total(gaps)
+            if saved > 0:
+                METRICS.inc("gateway.span_partial")
+                METRICS.inc("gateway.nonces_saved", saved)
+            else:
+                gaps, seed = None, None  # no coverage: plain full-range job
         vid = self._next_vid
         self._next_vid -= 1
         flight = _Inflight(vid=vid, key=key, client_key=client_key,
@@ -266,10 +333,10 @@ class Gateway:
         self._by_vid[vid] = flight
         self._conn_key[conn_id] = key
         METRICS.inc("gateway.admitted")
-        data, lower, upper = key
         return self._translate(
             self.sched.client_request(
-                vid, data, lower, upper, now, tenant=client_key
+                vid, data, lower, upper, now, tenant=client_key,
+                gaps=gaps, seed_best=seed,
             ),
             now,
         )
@@ -328,6 +395,32 @@ class Gateway:
             self._queue.remove_where(lambda item: self._resolve_twin(item, out))
         return out
 
+    def _span_answer(
+        self,
+        conn_id: int,
+        key: JobKey,
+        plan: Optional[Tuple[Optional[Tuple[int, int]], List[Interval]]] = None,
+    ) -> Optional[Action]:
+        """A full-coverage interval-store answer for ``key``, or None.
+        With no gaps, the fold of the overlapping spans' minima IS the
+        range's argmin (utils/intervals: every answerable portion's
+        minimum equals its span's fold, and the portions tile the query);
+        the answer also lands in the exact cache so later repeats cost
+        one dict hit even after span eviction.  ``plan`` reuses a
+        ``cover()`` the caller already paid for."""
+        data, lower, upper = key
+        if lower > upper:
+            return None  # empty range: the scheduler's (0, 0) contract
+        best, gaps = (
+            plan if plan is not None else self.spans.cover(data, lower, upper)
+        )
+        if gaps or best is None:
+            return None
+        METRICS.inc("gateway.span_hits")
+        METRICS.inc("gateway.nonces_saved", upper - lower + 1)
+        self.cache.put(key, best[0], best[1])
+        return (conn_id, Message.result(best[0], best[1]))
+
     def _resolve_twin(self, item: _Queued, out: List[Action]) -> bool:
         conn_id, key, _ = item
         hit = self.cache.get(key)
@@ -335,6 +428,11 @@ class Gateway:
             self._queued_conns.discard(conn_id)
             METRICS.inc("gateway.cache_hits")
             out.append((conn_id, Message.result(hit[0], hit[1])))
+            return True
+        answer = self._span_answer(conn_id, key)
+        if answer is not None:
+            self._queued_conns.discard(conn_id)
+            out.append(answer)
             return True
         flight = self._by_key.get(key)
         if flight is not None:

@@ -235,6 +235,14 @@ class AsyncServer:
     def port(self) -> int:
         return self._endpoint.local_addr[1]
 
+    def peer_host(self, conn_id: int) -> Optional[str]:
+        """The remote host of a live connection, or None once it is gone.
+        This is the stable per-client identity the serving layer binds
+        admission state to: a reconnecting client gets a fresh conn id and
+        a fresh UDP source port, but the same host."""
+        sc = self._conns.get(conn_id)
+        return sc.addr[0] if sc is not None else None
+
     # -- API -----------------------------------------------------------------
 
     async def read(self) -> Tuple[int, bytes]:

@@ -151,6 +151,15 @@ class Server:
     def port(self) -> int:
         return self._s.port
 
+    def peer_host(self, conn_id: int) -> Optional[str]:
+        """The remote host of a live conn (the admission-control client
+        identity — stable across reconnects, unlike the conn id), or None
+        if the conn is already gone or the server is closed."""
+        try:
+            return self._lt.call(self._s.peer_host, conn_id)
+        except ConnClosedError:
+            return None
+
     def read(self) -> Tuple[int, bytes]:
         """Block for the next message from any client.  Raises ConnLostError
         (with .conn_id) when a client dies, ConnClosedError once closed."""

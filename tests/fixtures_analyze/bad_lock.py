@@ -36,3 +36,36 @@ def serve_like(thing):
     with lock:
         state.ok()  # legal
     state.leak()  # SEEDED VIOLATION: local-off-lock
+
+
+class PairedCounter:
+    """Explicit acquire()/release() pairs beyond `with` blocks (ISSUE 5):
+    the canonical try/finally pairing is legal; a read AFTER the release
+    fires field-off-lock again."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded-by: _lock
+
+    def inc(self):
+        self._lock.acquire()
+        try:
+            self._n += 1  # legal: between acquire/release
+        finally:
+            self._lock.release()
+
+    def read_after_release(self):
+        self._lock.acquire()
+        self._lock.release()
+        return self._n  # SEEDED VIOLATION: post-release read
+
+
+def serve_like_paired(thing):
+    lock = threading.Lock()
+    state = thing  # guarded-by: lock
+    lock.acquire()
+    try:
+        state.ok()  # legal: between acquire/release
+    finally:
+        lock.release()
+    state.leak()  # SEEDED VIOLATION: local read after paired release

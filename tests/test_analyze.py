@@ -98,6 +98,34 @@ def test_lock_rules_fire_on_fixture():
     assert {"field-off-lock", "helper-off-lock", "local-off-lock"} <= rules
 
 
+def test_lock_pass_understands_acquire_release_pairs():
+    """Explicit acquire()/release() pairing (ISSUE 5): access between the
+    calls (the try/finally idiom) is LEGAL; access after the release
+    fires.  Both directions checked by line, for fields and for
+    serve-loop locals."""
+    src = (FIXTURES / "bad_lock.py").read_text().splitlines()
+
+    def line_of(marker):
+        return next(i + 1 for i, text in enumerate(src) if marker in text)
+
+    findings = _pass_findings("lock", FIXTURES)
+    flagged = {(f.symbol, f.line) for f in findings}
+    # The seeded post-release violations fire...
+    assert ("PairedCounter._n", line_of("post-release read")) in flagged
+    assert (
+        "serve_like_paired:state",
+        line_of("local read after paired release"),
+    ) in flagged
+    # ...and the legal between-acquire/release accesses do NOT.
+    legal_lines = {
+        i + 1
+        for i, text in enumerate(src)
+        if "legal: between acquire/release" in text
+    }
+    assert len(legal_lines) == 2  # one field access, one serve-loop local
+    assert not {(s, ln) for s, ln in flagged if ln in legal_lines}
+
+
 def test_wfq_rules_fire_on_fixture():
     rules = _rules(_pass_findings("wfq", FIXTURES))
     assert {"floor-init-reimplemented", "tiebreak-reimplemented"} <= rules

@@ -27,7 +27,13 @@ from bitcoin_miner_tpu.apps import server as server_mod
 from bitcoin_miner_tpu.apps.scheduler import Scheduler
 from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
 from bitcoin_miner_tpu.bitcoin.message import Message, MsgType
-from bitcoin_miner_tpu.gateway import FairQueue, Gateway, ResultCache, TokenBucket
+from bitcoin_miner_tpu.gateway import (
+    FairQueue,
+    Gateway,
+    ResultCache,
+    SpanStore,
+    TokenBucket,
+)
 from bitcoin_miner_tpu.utils.metrics import METRICS
 
 pytestmark = pytest.mark.gateway
@@ -275,6 +281,160 @@ class TestCacheFront:
         g2 = make_gateway()
         g2.load_checkpoint(state)
         assert g2.checkpoint()["jobs"] == state["jobs"]
+
+
+class TestIntervalServing:
+    """The interval-algebra result store on the serving path (ISSUE 5):
+    solved chunk spans answer sub-range queries; partial coverage sweeps
+    only the uncovered remainder and merges via the scheduler seed."""
+
+    def _solve_three_chunks(self, g, nonces=(50, 150, 210)):
+        """One [0,299] job swept as three 100-nonce chunks with controlled
+        argmins; returns after the job completed and spans recorded."""
+        g.miner_joined(1, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        g.result(1, hash_=700, nonce=nonces[0], now=1.0)
+        g.result(1, hash_=600, nonce=nonces[1], now=2.0)
+        g.result(1, hash_=650, nonce=nonces[2], now=3.0)
+
+    def test_covered_subrange_answers_with_zero_chunks(self):
+        METRICS.reset()
+        g = make_gateway(sched={"min_chunk": 100, "max_chunk": 100,
+                                "validate_results": False})
+        self._solve_three_chunks(g)
+        assigned = METRICS.get("sched.chunks_assigned")
+        # A NEVER-ISSUED strict sub-range, fully covered by solved spans
+        # (every overlapping span's argmin lies inside it).
+        acts = g.client_request(20, DATA, 50, 249, now=4.0)
+        assert results(acts) == [(20, acts[0][1])]
+        assert (acts[0][1].hash, acts[0][1].nonce) == (600, 150)
+        assert METRICS.get("sched.chunks_assigned") == assigned
+        assert METRICS.get("gateway.span_hits") == 1
+        assert METRICS.get("gateway.nonces_saved") == 200
+        # The span answer landed in the exact cache: a repeat is a plain
+        # cache hit even if the spans are later evicted.
+        acts = g.client_request(21, DATA, 50, 249, now=5.0)
+        assert METRICS.get("gateway.cache_hits") == 1
+
+    def test_argmin_outside_subrange_is_not_answered(self):
+        """A span whose minimum lives OUTSIDE the query proves nothing
+        about it: the portion must re-sweep (bit-exactness over reuse)."""
+        METRICS.reset()
+        g = make_gateway(sched={"min_chunk": 300, "max_chunk": 300,
+                                "validate_results": False})
+        g.miner_joined(1, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        g.result(1, hash_=700, nonce=290, now=1.0)  # argmin near the top
+        acts = g.client_request(20, DATA, 0, 99, now=2.0)
+        # Not answerable from the span: a real sweep starts instead.
+        assert results(acts) == []
+        assert requests(acts) and requests(acts)[0][1].lower == 0
+        assert METRICS.get("gateway.span_hits") == 0
+
+    def test_partial_coverage_sweeps_only_the_gap_and_merges(self):
+        METRICS.reset()
+        g = make_gateway(sched={"min_chunk": 100, "max_chunk": 100,
+                                "validate_results": False})
+        self._solve_three_chunks(g)
+        acts = g.client_request(20, DATA, 0, 499, now=4.0)
+        # Only the uncovered remainder [300, 499] is carved into chunks.
+        req = requests(acts)
+        assert req and all(m.lower >= 300 for _, m in req)
+        assert METRICS.get("gateway.span_partial") == 1
+        assert METRICS.get("gateway.nonces_saved") == 300
+        # Remainder results fold with the span seed: the final Result is
+        # the whole range's minimum even though [0,299] was never re-swept.
+        g.result(1, hash_=640, nonce=350, now=5.0)
+        done = results(g.result(1, hash_=660, nonce=450, now=6.0))
+        assert done == [(20, done[0][1])]
+        assert (done[0][1].hash, done[0][1].nonce) == (600, 150)
+        # The remainder's own chunks became spans too: a strict sub-range
+        # of the extended sweep (containing every boundary argmin) is now
+        # fully covered...
+        assert g.spans.cover(DATA, 50, 459)[1] == []
+        # ...while one cutting a boundary span away from its argmin (450)
+        # correctly keeps that portion in the gap list.
+        assert g.spans.cover(DATA, 50, 449)[1] == [(400, 449)]
+
+    def test_merged_result_bit_exact_vs_oracle(self):
+        """With real hashes and validation ON: solve [0,399] honestly,
+        then request the chunk-straddling [100,499] — the spans answer
+        [100,399] (those chunks sit fully inside), only [400,499] sweeps,
+        and the merged Result equals a from-scratch full sweep."""
+        g = Gateway(Scheduler(min_chunk=100, max_chunk=100), rate=None)
+        g.miner_joined(1, now=0.0)
+        outstanding = {}  # chunk assignments we owe honest answers to
+
+        def serve_requests(acts, now):
+            done = []
+            for cid, m in acts:
+                if m.type == MsgType.REQUEST:
+                    outstanding[(m.lower, m.upper)] = cid
+            while outstanding:
+                (lo, hi), cid = next(iter(outstanding.items()))
+                del outstanding[(lo, hi)]
+                h, n = min_hash_range(DATA, lo, hi)
+                now += 1.0
+                done += serve_requests(g.result(cid, h, n, now), now)
+            return done + results(acts)
+
+        first = serve_requests(g.client_request(10, DATA, 0, 399, now=0.0), 0.0)
+        assert [(c, m.hash, m.nonce) for c, m in first if c == 10] == [
+            (10, *min_hash_range(DATA, 0, 399))
+        ]
+        swept_before = METRICS.get("sched.nonces_swept")
+        second = serve_requests(g.client_request(20, DATA, 100, 499, now=50.0), 50.0)
+        assert [(c, m.hash, m.nonce) for c, m in second if c == 20] == [
+            (20, *min_hash_range(DATA, 100, 499))
+        ]
+        # Only the uncovered remainder [400,499] was re-swept.
+        assert METRICS.get("sched.nonces_swept") - swept_before == 100
+
+    def test_queued_request_replans_at_admit_time(self):
+        """Spans solved while a request waits in the admission queue are
+        visible at dispatch: a fully covered twin resolves from the queue
+        with no slot at all."""
+        METRICS.reset()
+        g = make_gateway(max_active=1,
+                         sched={"min_chunk": 300, "max_chunk": 300,
+                                "validate_results": False})
+        g.miner_joined(1, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        g.client_request(11, DATA, 100, 200, now=0.1)  # queued: slot full
+        assert g.stats()["gw_queued"] == 1
+        acts = g.result(1, hash_=700, nonce=150, now=1.0)
+        # The completion both answers 10 AND resolves 11 from the queue
+        # via the freshly recorded span (argmin 150 inside [100,200]).
+        assert sorted(cid for cid, _ in results(acts)) == [10, 11]
+        assert g.stats()["gw_queued"] == 0
+        assert METRICS.get("gateway.span_hits") == 1
+
+    def test_spans_disabled_gateway_still_correct(self):
+        METRICS.reset()
+        g = make_gateway(spans=SpanStore(capacity=0),
+                         sched={"min_chunk": 100, "max_chunk": 100,
+                                "validate_results": False})
+        self._solve_three_chunks(g)
+        assert g.sched.record_spans is False  # export never armed
+        acts = g.client_request(20, DATA, 50, 249, now=4.0)
+        assert results(acts) == []  # no span answers: a fresh sweep
+        assert requests(acts)
+        assert METRICS.get("gateway.span_hits") == 0
+
+    def test_gap_job_orphan_stash_is_whole_range_correct(self):
+        """A gap job dying mid-remainder stashes (seed-folded best,
+        remaining gaps) under the FULL signature: the resumed twin sweeps
+        only what was never covered by spans nor by the dead job."""
+        g = make_gateway(sched={"min_chunk": 100, "max_chunk": 100,
+                                "validate_results": False})
+        self._solve_three_chunks(g)
+        g.client_request(20, DATA, 0, 499, now=4.0)  # gaps [300,499]
+        g.result(1, hash_=640, nonce=350, now=5.0)  # [300,399] swept
+        g.lost(20, now=6.0)  # last waiter dies: orphan-stash the gap job
+        state = g.checkpoint()
+        [j] = [j for j in state["jobs"] if (j["lower"], j["upper"]) == (0, 499)]
+        assert j["best"] == [600, 150]  # the span seed survived the stash
+        assert j["remaining"] == [[400, 499]]
 
 
 class TestAdmission:
@@ -674,3 +834,65 @@ def test_gateway_cache_persists_across_fleet_restart(tmp_path):
         assert fleet2.request("gwpersist", 2500) == want
     finally:
         fleet2.close()
+
+
+def test_gateway_spans_persist_and_answer_subrange_after_restart(tmp_path):
+    """The ISSUE 5 acceptance shape over a real fleet: fleet 1 solves
+    [0, 2500]; fleet 2 (fresh server+scheduler, NO miners, same span
+    file) answers a never-issued strict SUB-RANGE purely from the
+    persisted interval store — zero chunks assigned, bit-exact."""
+    path = str(tmp_path / "spans.json")
+    fleet = GatewayFleet(n_miners=1, spans=SpanStore(path=path))
+    data = "gwspans"
+    try:
+        assert fleet.request(data, 2500) == min_hash_range(data, 0, 2500)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not len(SpanStore(path=path)):
+            time.sleep(0.05)
+        assert len(SpanStore(path=path)) > 0, "span flush never landed"
+    finally:
+        fleet.close()
+    fleet2 = GatewayFleet(n_miners=0, spans=SpanStore(path=path))
+    try:
+        # Pick a strict sub-range the store fully covers (candidates from
+        # the span geometry, verified through the planner — the same
+        # probe tools/loadgen.py --overlap runs).
+        sub = None
+        for _lo, s_hi, _h, n in fleet2.gateway.spans._maps[data].spans():
+            for qlo, qhi in ((0, s_hi), (0, n), (n, 2500)):
+                if (qlo, qhi) == (0, 2500) or qlo > qhi:
+                    continue
+                best, gaps = fleet2.gateway.spans.cover(data, qlo, qhi)
+                if not gaps and best is not None:
+                    sub = (qlo, qhi)
+                    break
+            if sub:
+                break
+        assert sub is not None, "no covered strict sub-range to probe"
+        assigned = METRICS.get("sched.chunks_assigned")
+        c = lsp.Client("127.0.0.1", fleet2.server.port, PARAMS)
+        try:
+            got = client_mod.request_once(c, data, sub[1], lower=sub[0])
+        finally:
+            c.close()
+        assert got == min_hash_range(data, sub[0], sub[1])
+        # Miner-less: only the interval store could have answered.
+        assert METRICS.get("sched.chunks_assigned") == assigned
+    finally:
+        fleet2.close()
+
+
+def test_gateway_buckets_bind_to_peer_addr_across_conns():
+    """Admission identity is the LSP remote addr, not the ephemeral conn
+    id (ISSUE 5 satellite): distinct conns from one host share ONE token
+    bucket, so rate limits survive reconnects."""
+    fleet = GatewayFleet(n_miners=1, rate=1000.0, burst=50.0)
+    try:
+        assert fleet.request("gwaddr1", 1500) == min_hash_range("gwaddr1", 0, 1500)
+        assert fleet.request("gwaddr2", 1500) == min_hash_range("gwaddr2", 0, 1500)
+        # Two requests, two conns, one host -> exactly one addr-keyed
+        # bucket (conn-keyed buckets would have minted two).
+        keys = set(fleet.gateway._buckets)
+        assert keys == {"addr:127.0.0.1"}, keys
+    finally:
+        fleet.close()

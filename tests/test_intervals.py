@@ -1,0 +1,231 @@
+"""The interval-algebra primitive + span store (ISSUE 5).
+
+Three layers, fast enough for tier-1 (marker ``intervals``):
+
+- pure-unit: ``merge_intervals`` / ``intersect_intervals`` algebra and
+  the :class:`IntervalMap` mechanics (overlap folding, adjacency kept
+  for resolution, budgeted shrink, the argmin-inside answerability rule);
+- property-style: random solved-span layouts over real hashlib minima —
+  for every random query, folding ``cover()``'s best with brute-force
+  sweeps of its gaps must be bit-identical to a from-scratch full sweep
+  (the ISSUE 5 bit-exactness acceptance, lowest-nonce ties included);
+- persistence: :class:`SpanStore` round-trip, torn/corrupt file -> clean
+  empty store, bad rows skipped, and the LRU/budget bounds.
+"""
+
+import random
+
+import pytest
+
+from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+from bitcoin_miner_tpu.gateway import SpanStore
+from bitcoin_miner_tpu.utils.intervals import (
+    IntervalMap,
+    intersect_intervals,
+    interval_total,
+    merge_intervals,
+)
+
+pytestmark = pytest.mark.intervals
+
+
+# ---------------------------------------------------------------- algebra
+
+
+def test_merge_intervals():
+    assert merge_intervals([]) == []
+    assert merge_intervals([(5, 9), (0, 4)]) == [(0, 9)]  # adjacent
+    assert merge_intervals([(0, 9), (3, 5)]) == [(0, 9)]  # contained
+    assert merge_intervals([(0, 2), (4, 6)]) == [(0, 2), (4, 6)]  # gap
+
+
+def test_intersect_intervals():
+    assert intersect_intervals([], [(0, 9)]) == []
+    assert intersect_intervals([(0, 9)], [(5, 15)]) == [(5, 9)]
+    assert intersect_intervals([(0, 3), (6, 9)], [(2, 7)]) == [(2, 3), (6, 7)]
+    assert intersect_intervals([(0, 9)], [(0, 9)]) == [(0, 9)]
+    assert intersect_intervals([(0, 4)], [(5, 9)]) == []
+    # unsorted/overlapping inputs are normalized first
+    assert intersect_intervals([(5, 9), (0, 6)], [(4, 4)]) == [(4, 4)]
+
+
+def test_interval_total():
+    assert interval_total([]) == 0
+    assert interval_total([(0, 0), (5, 9)]) == 6
+
+
+# ------------------------------------------------------------ IntervalMap
+
+
+def test_map_disjoint_spans_kept_adjacent_not_merged():
+    m = IntervalMap()
+    m.add(0, 99, 700, 50)
+    m.add(100, 199, 600, 150)  # adjacent: kept separate (resolution)
+    assert m.spans() == [(0, 99, 700, 50), (100, 199, 600, 150)]
+
+
+def test_map_overlapping_spans_fold():
+    m = IntervalMap()
+    m.add(0, 99, 700, 50)
+    m.add(50, 149, 600, 120)  # overlap: union covered -> fold is exact
+    assert m.spans() == [(0, 149, 600, 120)]
+
+
+def test_map_refuses_malformed_spans():
+    m = IntervalMap()
+    m.add(10, 5, 1, 7)  # empty
+    m.add(0, 9, 1, 50)  # argmin outside its own range: unusable evidence
+    assert len(m) == 0
+
+
+def test_cover_full_when_argmins_inside():
+    m = IntervalMap()
+    m.add(0, 99, 700, 50)
+    m.add(100, 199, 600, 150)
+    best, gaps = m.cover(20, 180)
+    assert best == (600, 150) and gaps == []
+
+
+def test_cover_argmin_outside_query_is_a_gap():
+    m = IntervalMap()
+    m.add(0, 99, 700, 90)
+    # The span's minimum lives at 90, outside [0, 50]: the fold proves
+    # nothing about [0, 50], which must be re-swept.
+    best, gaps = m.cover(0, 50)
+    assert best is None and gaps == [(0, 50)]
+    # ...but any query containing the argmin is answered.
+    best, gaps = m.cover(50, 99)
+    assert best == (700, 90) and gaps == []
+
+
+def test_cover_mixed_gaps_and_answers():
+    m = IntervalMap()
+    m.add(10, 19, 700, 15)
+    m.add(40, 49, 600, 45)
+    best, gaps = m.cover(0, 60)
+    assert best == (600, 45)
+    assert gaps == [(0, 9), (20, 39), (50, 60)]
+
+
+def test_cover_empty_and_miss():
+    m = IntervalMap()
+    assert m.cover(5, 4) == (None, [])
+    assert m.cover(0, 9) == (None, [(0, 9)])
+
+
+def test_budget_prefers_adjacent_coalesce_then_drops_narrowest():
+    m = IntervalMap(max_spans=2)
+    m.add(0, 9, 700, 5)
+    m.add(10, 19, 600, 15)
+    m.add(30, 39, 650, 35)
+    # Three spans, budget two: the adjacent pair [0,9]+[10,19] coalesces
+    # (fold min), the disjoint [30,39] survives untouched.
+    assert m.spans() == [(0, 19, 600, 15), (30, 39, 650, 35)]
+    m.add(60, 69, 640, 65)
+    # No adjacency left: the narrowest span is forgotten (all are width
+    # 10 except the coalesced [0,19] — a width-10 one goes).
+    assert len(m) == 2
+    assert (0, 19, 600, 15) in m.spans()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_property_cover_plus_remainder_equals_full_sweep(seed):
+    """Random span layouts over REAL minima: for any query, span-fold +
+    gap-sweep == from-scratch sweep, bit-exact, lowest-nonce ties."""
+    rng = random.Random(seed)
+    data = f"prop{seed}"
+    m = IntervalMap(max_spans=rng.choice([3, 8, 64]))
+    domain = 500
+    for _ in range(rng.randint(1, 10)):
+        lo = rng.randint(0, domain - 1)
+        hi = min(domain - 1, lo + rng.randint(0, 80))
+        h, n = min_hash_range(data, lo, hi)
+        m.add(lo, hi, h, n)
+    for _ in range(8):
+        qlo = rng.randint(0, domain - 1)
+        qhi = rng.randint(qlo, domain - 1)
+        best, gaps = m.cover(qlo, qhi)
+        # gaps are sorted, disjoint, inside the query
+        assert gaps == merge_intervals(gaps)
+        assert all(qlo <= lo <= hi <= qhi for lo, hi in gaps)
+        folded = [best] if best is not None else []
+        folded += [min_hash_range(data, lo, hi) for lo, hi in gaps]
+        assert folded, "cover returned neither answers nor gaps"
+        assert min(folded) == min_hash_range(data, qlo, qhi)
+
+
+# -------------------------------------------------- SpanStore persistence
+
+
+def test_spanstore_roundtrip(tmp_path):
+    path = str(tmp_path / "spans.json")
+    s = SpanStore(path=path)
+    s.add("a", 0, 99, 700, 50)
+    s.add("a", 100, 199, 600, 150)
+    s.add("b", 10, 19, 500, 12)
+    s.save(path)
+    s2 = SpanStore(path=path)
+    assert len(s2) == 3
+    assert s2.cover("a", 20, 180) == ((600, 150), [])
+    assert s2.cover("b", 10, 19) == ((500, 12), [])
+
+
+def test_spanstore_flush_is_dirty_gated(tmp_path):
+    s = SpanStore(path=str(tmp_path / "s.json"))
+    assert s.flush() is None  # clean at birth
+    s.add("a", 0, 9, 700, 5)
+    state = s.flush()
+    assert state is not None and state["data"] == [["a", [[0, 9, 700, 5]]]]
+    assert s.flush() is None  # flush cleared the flag
+    s.cover("a", 0, 9)
+    assert s.flush() is None  # reads do not dirty
+    s.mark_dirty()
+    assert s.flush() is not None  # the shell's write-failure re-arm
+
+
+def test_spanstore_torn_file_starts_empty(tmp_path):
+    path = tmp_path / "spans.json"
+    path.write_text('{"version": 1, "data": [["a", [[0')  # truncated
+    s = SpanStore(path=str(path))
+    assert len(s) == 0
+    assert s.flush() is None  # an empty fresh load is not dirty
+
+
+def test_spanstore_bad_rows_skipped_not_fatal(tmp_path):
+    path = tmp_path / "spans.json"
+    path.write_text(
+        '{"version": 1, "data": ['
+        '["good", [[0, 9, 700, 5], [99], [0, 9, true, 5], [0, 9, 700, 50]]], '
+        '[3, [[0, 9, 1, 2]]], "junk"]}'
+    )
+    s = SpanStore(path=str(path))
+    # one valid row survives ([0,9,700,50] has its argmin outside -> refused)
+    assert len(s) == 1
+    assert s.cover("good", 0, 9) == ((700, 5), [])
+
+
+def test_spanstore_lru_bounds_data_keys(tmp_path):
+    from bitcoin_miner_tpu.utils.metrics import METRICS
+
+    METRICS.reset()
+    s = SpanStore(capacity=2)
+    s.add("a", 0, 9, 700, 5)
+    s.add("b", 0, 9, 600, 5)
+    s.cover("a", 0, 9)  # freshen a: b is now the LRU victim
+    s.add("c", 0, 9, 650, 5)
+    assert s.data_count() == 2
+    assert s.cover("b", 0, 9) == (None, [(0, 9)])  # evicted
+    assert s.cover("a", 0, 9)[0] == (700, 5)
+    assert METRICS.get("gateway.span_evictions") == 1
+
+
+def test_spanstore_span_budget_bounded(tmp_path):
+    s = SpanStore(max_spans_per_data=4)
+    for i in range(0, 40, 2):  # 20 NON-adjacent spans (gaps between)
+        lo = i * 10
+        s.add("a", lo, lo + 5, 700 + i, lo)
+    assert len(s) <= 4  # budget held even with nothing to coalesce
+    path_free = SpanStore(capacity=0)
+    path_free.add("a", 0, 9, 700, 5)
+    assert len(path_free) == 0  # capacity=0 disables storage entirely
+    assert path_free.cover("a", 0, 9) == (None, [(0, 9)])

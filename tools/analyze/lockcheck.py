@@ -18,6 +18,17 @@ Annotation vocabulary (see README "Static analysis & sanitizers"):
   finding (the documented-intentional escape hatch, e.g. the chaos
   engine's benign racy ``_enabled`` fast path).
 
+Explicit ``lock.acquire()`` / ``lock.release()`` pairs are understood
+beyond ``with`` blocks (ISSUE 5): a bare ``self._lock.acquire()`` (or
+``lock.acquire()``) *statement* marks the lock held for the statements
+that follow it in the same suite — including a ``try`` body whose
+``finally`` releases, the canonical pairing idiom — and a ``release()``
+anywhere inside a compound statement ends the credit when that statement
+completes, so a read AFTER the release is flagged again.  Only bare
+expression statements earn held-credit: an assigned
+``ok = lock.acquire(timeout=...)`` may have failed, and an acquire inside
+a conditional branch is not assumed on the fall-through path.
+
 Two registry rules ride along: externally-serialized policy classes
 (Scheduler, Gateway, ...) must never grow a ``threading.`` dependency,
 and internally-locked classes must not lose their annotations entirely.
@@ -67,15 +78,61 @@ def _with_locks(stmt: ast.With) -> Set[str]:
     bare ``name`` -> name."""
     out: Set[str] = set()
     for item in stmt.items:
-        e = item.context_expr
+        name = _lock_name(item.context_expr)
+        if name is not None:
+            out.add(name)
+    return out
+
+
+def _lock_name(e: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``X``, bare ``name`` -> ``name`` (the two spellings
+    the annotation vocabulary uses for lock references)."""
+    if (
+        isinstance(e, ast.Attribute)
+        and isinstance(e.value, ast.Name)
+        and e.value.id == "self"
+    ):
+        return e.attr
+    if isinstance(e, ast.Name):
+        return e.id
+    return None
+
+
+def _pair_call(stmt: ast.stmt, which: str) -> Set[str]:
+    """Lock names from a bare ``X.acquire()`` / ``X.release()`` expression
+    statement.  Statements only: an assigned ``ok = lock.acquire(...)``
+    may have returned False, so it earns no held-credit."""
+    out: Set[str] = set()
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        f = stmt.value.func
+        if isinstance(f, ast.Attribute) and f.attr == which:
+            name = _lock_name(f.value)
+            if name is not None:
+                out.add(name)
+    return out
+
+
+def _releases_within(stmt: ast.stmt) -> Set[str]:
+    """Every lock ``release()``d anywhere inside ``stmt`` — nested defs
+    excluded (a closure's release happens on some later call, not on this
+    control path).  Used to END the held-credit once a compound statement
+    (typically ``try ... finally: lock.release()``) completes."""
+    out: Set[str] = set()
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
         if (
-            isinstance(e, ast.Attribute)
-            and isinstance(e.value, ast.Name)
-            and e.value.id == "self"
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node is not stmt
         ):
-            out.add(e.attr)
-        elif isinstance(e, ast.Name):
-            out.add(e.id)
+            continue
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "release":
+                name = _lock_name(f.value)
+                if name is not None:
+                    out.add(name)
+        stack.extend(ast.iter_child_nodes(node))
     return out
 
 
@@ -144,7 +201,14 @@ class _ClassChecker:
         method: str,
         func: ast.AST,
     ) -> None:
+        held = set(held)  # sequential acquire()/release() mutate a copy
         for stmt in body:
+            acq = _pair_call(stmt, "acquire")
+            rel = _pair_call(stmt, "release")
+            if acq or rel:
+                held |= acq
+                held -= rel
+                continue
             if isinstance(stmt, ast.With):
                 inner = held | _with_locks(stmt)
                 self._check_exprs(stmt, held, method, stmt, header_only=True)
@@ -166,6 +230,9 @@ class _ClassChecker:
                     self._walk(sub, held, method, func)
             for handler in getattr(stmt, "handlers", ()) or ():
                 self._walk(handler.body, held, method, func)
+            # try/finally: lock.release() (or any release in a branch):
+            # the credit ends when the compound statement completes.
+            held -= _releases_within(stmt)
 
     def _check_exprs(
         self,
@@ -288,7 +355,14 @@ class _FunctionChecker:
         return self.findings
 
     def _walk(self, body: List[ast.stmt], held: Set[str]) -> None:
+        held = set(held)  # sequential acquire()/release() mutate a copy
         for stmt in body:
+            acq = _pair_call(stmt, "acquire")
+            rel = _pair_call(stmt, "release")
+            if acq or rel:
+                held |= acq
+                held -= rel
+                continue
             if isinstance(stmt, ast.With):
                 self._check_stmt_header(stmt, held)
                 self._walk(stmt.body, held | _with_locks(stmt))
@@ -308,6 +382,7 @@ class _FunctionChecker:
                         self._walk(sub, held)
                 for handler in getattr(stmt, "handlers", ()) or ():
                     self._walk(handler.body, held)
+                held -= _releases_within(stmt)  # credit ends with the try
                 continue
             self._check_expr(stmt, held, stmt)
 

@@ -25,9 +25,11 @@ EXTERNAL_CLASSES = (
     ("bitcoin_miner_tpu/apps/scheduler.py", "Scheduler"),
     ("bitcoin_miner_tpu/gateway/core.py", "Gateway"),
     ("bitcoin_miner_tpu/gateway/cache.py", "ResultCache"),
+    ("bitcoin_miner_tpu/gateway/cache.py", "SpanStore"),
     ("bitcoin_miner_tpu/gateway/admission.py", "FairQueue"),
     ("bitcoin_miner_tpu/gateway/admission.py", "TokenBucket"),
     ("bitcoin_miner_tpu/utils/wfq.py", "VirtualClockWFQ"),
+    ("bitcoin_miner_tpu/utils/intervals.py", "IntervalMap"),
 )
 
 #: Internally-locked classes expected to carry ``# guarded-by:`` field

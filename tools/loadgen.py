@@ -22,8 +22,17 @@ answers with ZERO new chunks assigned.  Prints one JSON line; `--fast`
 keeps the whole thing under ~30 s on CPU so it gates tier-1
 (tests/test_loadgen.py).
 
-Usage: python tools/loadgen.py [--fast] [--clients N] [--jobs N]
-       [--dup F] [--max-nonce N] [--miners N] [--no-baseline] [--seed N]
+`--overlap` (ISSUE 5) switches to the interval-store regime: a
+nested/overlapping-range workload over a few shared data keys, run twice
+— an interval-store leg (`SpanStore` armed) vs an exact-match-cache leg
+(`SpanStore(capacity=0)`) — bit-exact both, plus a probe asserting a
+never-issued, fully covered SUB-RANGE of solved work answers with zero
+chunks assigned.  The JSON line reports both legs' `swept_nonces` and
+their reduction (the BENCH_pr5.json artifact).
+
+Usage: python tools/loadgen.py [--fast] [--overlap] [--clients N]
+       [--jobs N] [--dup F] [--max-nonce N] [--miners N] [--no-baseline]
+       [--seed N]
 """
 
 from __future__ import annotations
@@ -67,16 +76,51 @@ def build_workload(args) -> list:
     return jobs
 
 
-def run_leg(gateway_on: bool, jobs: list, args, oracle: dict) -> dict:
+def build_overlap_workload(args) -> list:
+    """Overlap-heavy jobs for the interval store (ISSUE 5): a few shared
+    data keys, each hit by growing prefixes ``[0, hi]`` (extensions sweep
+    only the new tail), interior sub-ranges ``[lo, hi]`` (answered from
+    chunk spans), and exact repeats (both stores should catch those) —
+    the many-clients regime where ranges nest and overlap but rarely
+    repeat exactly."""
+    rng = random.Random(args.seed)
+    datas = [f"ov{i}" for i in range(3)]
+    issued: list = []
+    jobs: list = []
+    for _ in range(args.jobs):
+        r = rng.random()
+        if issued and r < 0.15:
+            sig = rng.choice(issued)  # exact repeat
+        elif r < 0.55:
+            # growing prefix: nested [0, hi] family on one data key
+            data = rng.choice(datas)
+            hi = rng.randint(args.max_nonce // 4, args.max_nonce)
+            sig = (data, 0, hi)
+        else:
+            # interior sub-range of the same families
+            data = rng.choice(datas)
+            lo = rng.randint(0, args.max_nonce // 2)
+            hi = rng.randint(lo, args.max_nonce)
+            sig = (data, lo, hi)
+        issued.append(sig)
+        jobs.append(sig)
+    return jobs
+
+
+def run_leg(
+    gateway_on: bool, jobs: list, args, oracle: dict, spans_on: bool = True
+) -> dict:
     """Stand up one in-process fleet, push the whole workload through it
     with ``--clients`` concurrent client threads, tear it down.  Returns
-    the leg's timing + METRICS deltas."""
+    the leg's timing + METRICS deltas.  ``spans_on=False`` runs the
+    gateway with the interval store disabled — the exact-match-cache
+    comparison leg of the --overlap bench."""
     from bitcoin_miner_tpu import lsp
     from bitcoin_miner_tpu.apps import client as client_mod
     from bitcoin_miner_tpu.apps import miner as miner_mod
     from bitcoin_miner_tpu.apps import server as server_mod
     from bitcoin_miner_tpu.apps.scheduler import Scheduler
-    from bitcoin_miner_tpu.gateway import Gateway, ResultCache
+    from bitcoin_miner_tpu.gateway import Gateway, ResultCache, SpanStore
     from bitcoin_miner_tpu.utils.metrics import METRICS
 
     params = lsp.Params(epoch_limit=5, epoch_millis=200, window_size=5)
@@ -86,7 +130,10 @@ def run_leg(gateway_on: bool, jobs: list, args, oracle: dict) -> dict:
         Gateway(
             sched,
             cache=ResultCache(capacity=args.cache_size),
-            rate=None,  # per-conn buckets never bind over LSP; see README
+            spans=SpanStore() if spans_on else SpanStore(capacity=0),
+            # All loopback clients share one peer addr, so a real rate
+            # limit would throttle the whole bench as ONE client.
+            rate=None,
             max_active=args.max_active,
         )
         if gateway_on
@@ -120,7 +167,7 @@ def run_leg(gateway_on: bool, jobs: list, args, oracle: dict) -> dict:
             data, lo, hi = jobs[job_i]
             c = lsp.Client("127.0.0.1", server.port, params)
             try:
-                got = client_mod.request_once(c, data, hi)
+                got = client_mod.request_once(c, data, hi, lower=lo)
             finally:
                 c.close()
             want = oracle[(data, lo, hi)]
@@ -144,6 +191,7 @@ def run_leg(gateway_on: bool, jobs: list, args, oracle: dict) -> dict:
     wall = time.monotonic() - t0
 
     repeat_zero_chunks = None
+    subrange_zero_chunks = None
     if gateway_on and not errors:
         # Acceptance probe: a repeat of a SOLVED signature must answer
         # from the cache with zero new chunks assigned.
@@ -151,7 +199,7 @@ def run_leg(gateway_on: bool, jobs: list, args, oracle: dict) -> dict:
         data, lo, hi = jobs[0]
         c = lsp.Client("127.0.0.1", server.port, params)
         try:
-            got = client_mod.request_once(c, data, hi)
+            got = client_mod.request_once(c, data, hi, lower=lo)
         finally:
             c.close()
         if got != oracle[(data, lo, hi)]:
@@ -161,6 +209,10 @@ def run_leg(gateway_on: bool, jobs: list, args, oracle: dict) -> dict:
         )
         if not repeat_zero_chunks:
             errors.append("repeat probe assigned chunks (cache missed)")
+    if gateway_on and spans_on and not errors:
+        subrange_zero_chunks = _subrange_probe(
+            engine, server, params, jobs, errors
+        )
 
     server.close()
     after = METRICS.snapshot()
@@ -180,7 +232,64 @@ def run_leg(gateway_on: bool, jobs: list, args, oracle: dict) -> dict:
         "jobs_per_sec": len(jobs) / wall if wall > 0 else 0.0,
         "counters": deltas,
         "repeat_zero_chunks": repeat_zero_chunks,
+        "subrange_zero_chunks": subrange_zero_chunks,
     }
+
+
+def _subrange_probe(engine, server, params, jobs, errors):
+    """The ISSUE 5 acceptance probe: find a NEVER-ISSUED strict sub-range
+    of the widest solved signature that the interval store fully covers,
+    request it over the wire, and assert it answers bit-exact with zero
+    chunks assigned (mirroring the exact-repeat `repeat_zero_chunks`
+    probe)."""
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.apps import client as client_mod
+    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+    from bitcoin_miner_tpu.utils.metrics import METRICS
+
+    issued = set(jobs)
+    data, lo, hi = max(jobs, key=lambda s: s[2] - s[1])
+    # Candidate sub-ranges built from the solved-span geometry: prefixes
+    # ending at a span boundary are covered whenever the spans are
+    # contiguous; prefixes/suffixes cut AT a recorded argmin keep the
+    # boundary span answerable by construction.  Each candidate is
+    # re-verified through the planner itself before use.
+    span_map = engine.spans._maps.get(data)
+    if span_map is None:
+        errors.append(f"no solved spans recorded for {data!r}")
+        return False
+    sub = None
+    for s_lo, s_hi, _h, n in span_map.spans():
+        for cand in ((lo, s_hi), (lo, n), (n, hi)):
+            qlo, qhi = cand
+            if not (lo <= qlo <= qhi <= hi) or (qlo, qhi) == (lo, hi):
+                continue
+            if (data, qlo, qhi) in issued:
+                continue
+            best, gaps = engine.spans.cover(data, qlo, qhi)
+            if not gaps and best is not None:
+                sub = (qlo, qhi)
+                break
+        if sub is not None:
+            break
+    if sub is None:
+        errors.append("no fully covered strict sub-range found to probe")
+        return False
+    assigned_before = METRICS.get("sched.chunks_assigned")
+    c = lsp.Client("127.0.0.1", server.port, params)
+    try:
+        got = client_mod.request_once(c, data, sub[1], lower=sub[0])
+    finally:
+        c.close()
+    want = min_hash_range(data, sub[0], sub[1])
+    if got != want:
+        errors.append(
+            f"subrange probe ({data},{sub[0]},{sub[1]}): got {got}, want {want}"
+        )
+    ok = METRICS.get("sched.chunks_assigned") == assigned_before
+    if not ok:
+        errors.append("subrange probe assigned chunks (interval store missed)")
+    return ok
 
 
 def main(argv=None) -> int:
@@ -198,6 +307,9 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the bare-scheduler comparison leg")
+    ap.add_argument("--overlap", action="store_true",
+                    help="interval-store bench: nested/overlapping ranges, "
+                         "SpanStore leg vs exact-match-cache leg")
     ap.add_argument("--fast", action="store_true",
                     help="tier-1 preset: small jobs, done in well under 30 s")
     args = ap.parse_args(argv)
@@ -208,7 +320,7 @@ def main(argv=None) -> int:
 
     from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
 
-    jobs = build_workload(args)
+    jobs = build_overlap_workload(args) if args.overlap else build_workload(args)
     distinct = sorted(set(jobs))
     log(f"workload: {len(jobs)} jobs, {len(distinct)} distinct signatures, "
         f"{args.clients} clients, {args.miners} miners")
@@ -217,6 +329,9 @@ def main(argv=None) -> int:
     # Throwaway warm-up leg: pay the one-time costs (native backend build,
     # transport/module init) so neither timed leg absorbs them.
     run_leg(False, jobs[: min(4, len(jobs))], args, oracle)
+
+    if args.overlap:
+        return _overlap_main(jobs, distinct, args, oracle)
 
     gw = run_leg(True, jobs, args, oracle)
     log(f"gateway leg: {gw['jobs_per_sec']:.2f} jobs/s over "
@@ -261,6 +376,55 @@ def main(argv=None) -> int:
             if base is not None
             else {}
         ),
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _overlap_main(jobs, distinct, args, oracle) -> int:
+    """The --overlap bench: interval-store leg vs exact-match-cache leg
+    (both gateways — the delta isolates the span store), one JSON line
+    with both legs' swept nonces and their reduction (BENCH_pr5.json)."""
+    spans = run_leg(True, jobs, args, oracle, spans_on=True)
+    log(f"interval-store leg: {spans['jobs_per_sec']:.2f} jobs/s over "
+        f"{spans['wall_s']:.2f}s; counters {spans['counters']}")
+    exact = run_leg(True, jobs, args, oracle, spans_on=False)
+    log(f"exact-cache leg: {exact['jobs_per_sec']:.2f} jobs/s over "
+        f"{exact['wall_s']:.2f}s; counters {exact['counters']}")
+
+    spans_swept = spans["counters"].get("sched.nonces_swept", 0)
+    exact_swept = exact["counters"].get("sched.nonces_swept", 0)
+    out = {
+        "metric": "loadgen_overlap_jobs_per_sec",
+        "value": round(spans["jobs_per_sec"], 3),
+        "unit": "jobs/s",
+        "mode": "overlap",
+        "clients": args.clients,
+        "jobs": len(jobs),
+        "distinct_signatures": len(distinct),
+        "max_nonce": args.max_nonce,
+        "miners": args.miners,
+        "seed": args.seed,
+        "fast": bool(args.fast),
+        "wall_s": round(spans["wall_s"], 3),
+        "repeat_zero_chunks": spans["repeat_zero_chunks"],
+        "subrange_zero_chunks": spans["subrange_zero_chunks"],
+        "span_counters": {
+            k: v for k, v in spans["counters"].items()
+            if k.startswith("gateway.")
+        },
+        "swept_nonces": spans_swept,
+        "exact_jobs_per_sec": round(exact["jobs_per_sec"], 3),
+        "exact_wall_s": round(exact["wall_s"], 3),
+        "exact_swept_nonces": exact_swept,
+        "swept_reduction": round(1.0 - spans_swept / exact_swept, 3)
+        if exact_swept > 0
+        else None,
+        "speedup_vs_exact": round(
+            spans["jobs_per_sec"] / exact["jobs_per_sec"], 3
+        )
+        if exact["jobs_per_sec"] > 0
+        else None,
     }
     print(json.dumps(out), flush=True)
     return 0
