@@ -13,6 +13,7 @@ from typing import Callable, List, Optional, TextIO, Tuple
 
 from .. import lsp
 from ..bitcoin.message import Message, MsgType
+from ..utils import trace
 from ..utils.metrics import METRICS
 
 
@@ -75,6 +76,13 @@ def request_with_retry(
             # Counted only once a Request will actually be resubmitted —
             # failed reconnect attempts are not resubmissions.
             METRICS.inc("client.resubmits")
+            # The resubmission mints a FRESH trace at the gateway; this
+            # fleet event lets the reconstructor tie the new tree back to
+            # the retry (same (data, 0, max_nonce) identity).
+            trace.emit(
+                None, "client", "resubmit",
+                data=message[:64], max_nonce=max_nonce, attempt=attempt,
+            )
         try:
             result = request_once(client, message, max_nonce)
         finally:

@@ -27,6 +27,7 @@ from typing import Dict, Optional, Tuple, Union
 from .. import lsp, lspnet
 from ..bitcoin.hash import min_hash_range
 from ..lspnet.chaos import CHAOS, Schedule, standard_scenarios
+from ..utils import trace
 from ..utils.metrics import METRICS
 from . import client as client_mod
 from . import miner as miner_mod
@@ -75,8 +76,40 @@ def run_drill(
     straggler_min_seconds: float = 4.0,
     retries: int = 6,
     timeout: float = 120.0,
+    trace_path: Optional[str] = None,
 ) -> DrillReport:
-    """Run one seeded fleet-under-chaos drill; see module docstring."""
+    """Run one seeded fleet-under-chaos drill; see module docstring.
+
+    ``trace_path`` arms the structured event log (utils/trace.py) for the
+    drill's duration and flushes it there as JSONL on exit — a seeded
+    chaos replay plus its trace is a deterministic diagnosis
+    (``python -m tools.trace FILE`` rebuilds the request timelines and
+    the tier-abandonment WHYs, ISSUE 6)."""
+    from contextlib import nullcontext
+
+    with trace.tracing(trace_path) if trace_path is not None else nullcontext():
+        return _drill(
+            scenario, seed, data, max_nonce, n_miners, kill_miner_at,
+            epoch_millis, epoch_limit, window, min_chunk,
+            straggler_min_seconds, retries, timeout,
+        )
+
+
+def _drill(
+    scenario: Union[Schedule, str, None],
+    seed: int,
+    data: str,
+    max_nonce: int,
+    n_miners: int,
+    kill_miner_at: Optional[float],
+    epoch_millis: int,
+    epoch_limit: int,
+    window: int,
+    min_chunk: int,
+    straggler_min_seconds: float,
+    retries: int,
+    timeout: float,
+) -> DrillReport:
     params = lsp.Params(epoch_limit, epoch_millis, window)
     name = scenario if isinstance(scenario, str) else (
         getattr(scenario, "desc", "") or "custom" if scenario else "clean"

@@ -26,9 +26,32 @@ from typing import Callable, Optional, Tuple
 from .. import lsp
 from ..bitcoin.hash import min_hash_range
 from ..bitcoin.message import Message, MsgType
+from ..utils import trace
 from ..utils.metrics import METRICS
 
 SearchFn = Callable[[str, int, int], Tuple[int, int]]  # -> (hash, nonce)
+
+
+def _time_chunk(fut, lo: int, hi: int) -> None:
+    """Attach miner-side chunk timing to a search future: submit→solve
+    wall time into ``hist.miner_chunk_s`` plus a trace event when armed —
+    the miner half of the per-request timeline (the scheduler only sees
+    the round trip including the wire)."""
+    import time as _time
+
+    t0 = _time.monotonic()
+
+    def _done(f) -> None:
+        if f.cancelled() or f.exception() is not None:
+            return
+        dt = _time.monotonic() - t0
+        METRICS.observe("hist.miner_chunk_s", dt)
+        if trace.enabled():
+            trace.emit(
+                None, "miner", "chunk_done", lo=lo, hi=hi, dt=round(dt, 6)
+            )
+
+    fut.add_done_callback(_done)
 
 
 def make_search(backend: str = "auto", devices: Optional[int] = None) -> SearchFn:
@@ -216,9 +239,9 @@ def run_miner(client: "lsp.Client", search, close_search: bool = True) -> bool:
             if msg is None or msg.type != MsgType.REQUEST:
                 continue
             try:
-                inflight.put(
-                    (asearch.submit(msg.data, msg.lower, msg.upper), msg)
-                )
+                fut = asearch.submit(msg.data, msg.lower, msg.upper)
+                _time_chunk(fut, msg.lower, msg.upper)
+                inflight.put((fut, msg))
                 prewarm = getattr(asearch, "prewarm", None)
                 if prewarm is not None:
                     prewarm(msg.data, msg.upper)
@@ -315,6 +338,10 @@ def run_miner_resilient(
                 except (lsp.LspError, OSError):
                     failures += 1
                     if failures > max_retries:
+                        trace.emit(
+                            None, "miner", "gave_up",
+                            label=label, attempts=failures,
+                        )
                         print(
                             f"miner: giving up after {max_retries} reconnect "
                             "attempts", file=sys.stderr,
@@ -323,9 +350,13 @@ def run_miner_resilient(
                     if pause(backoff_delay(failures, backoff_base, backoff_cap)):
                         return
                     continue
-                failures = 0
                 if connected_before:
                     METRICS.inc("miner.reconnects")
+                    trace.emit(
+                        None, "miner", "reconnect",
+                        label=label, attempts=failures,
+                    )
+                failures = 0
             connected_before = True
             conn_lost = False
             try:
@@ -438,6 +469,12 @@ class _TieredSearch:
 
         METRICS.inc("miner.tier_downgrades")
         self._downgrades += 1
+        # Trace the WHY (ISSUE 6): a chaos soak's trace shows which tier
+        # was abandoned and for what reason, not just a counter bump.
+        trace.emit(
+            None, "miner", "tier_downgrade",
+            tier=self._active_name, why=why, downgrades=self._downgrades,
+        )
         print(
             f"miner: tier {self._active_name!r} {why}; downgrading",
             file=sys.stderr,
@@ -478,6 +515,11 @@ class _TieredSearch:
                     if self._closing:
                         out.set_exception(RuntimeError("search closed"))
                         break
+                    trace.emit(
+                        None, "miner", "wedge_detected",
+                        tier=self._active_name, budget_s=budget,
+                        lo=lo, hi=hi,
+                    )
                     self._downgrade(f"wedged (> {budget:g}s/chunk)")
                 except Exception as e:
                     if self._closing:

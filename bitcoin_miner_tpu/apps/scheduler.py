@@ -69,6 +69,8 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from ..bitcoin.hash import hash_nonce
 from ..bitcoin.message import Message
+from ..utils import trace as _trace  # _trace: the event-log module; job.trace / the
+# ``trace=`` event parameter are per-request ids (ISSUE 6)
 from ..utils.intervals import intersect_intervals, merge_intervals
 from ..utils.metrics import METRICS
 from ..utils.wfq import VirtualClockWFQ
@@ -128,6 +130,12 @@ class _Job:
     # Result does arrive first, the duplicate pending copy is withdrawn.
     requeued: Dict[int, List[Interval]] = field(default_factory=dict)
     best: Optional[Tuple[int, int]] = None  # (hash, nonce)
+    # Observability (ISSUE 6): the request's trace id (minted at the
+    # gateway; the bare scheduler mints its own when tracing is armed)
+    # and its birth time — every dispatch/result event carries the id, so
+    # one trace reconstructs the job's whole timeline.
+    trace: Optional[int] = None
+    t0: float = 0.0
 
     def fold(self, hash_: int, nonce: int) -> None:
         cand = (hash_, nonce)
@@ -231,6 +239,7 @@ class Scheduler:
         weight: float = 1.0,
         gaps: Optional[List[Interval]] = None,
         seed_best: Optional[Tuple[int, int]] = None,
+        trace: Optional[int] = None,
     ) -> List[Action]:
         """``tenant``/``weight`` name the fair-queue principal this job is
         charged to (the gateway passes its per-client key); default is the
@@ -243,15 +252,28 @@ class Scheduler:
         rides ``job.best``, the emitted Result AND the checkpoint identity
         stay whole-range-correct: an orphaned gap job stashes ``(best,
         remaining)`` under ``(data, lower, upper)`` exactly like a
-        full-range job, so any later twin resumes it soundly."""
+        full-range job, so any later twin resumes it soundly.
+
+        ``trace`` is the request's event-log id (utils/trace.py): the
+        gateway threads its minted id through; a bare scheduler mints its
+        own when tracing is armed, so direct fleets trace too."""
         self.revision += 1
         if conn_id in self.jobs or conn_id in self.miners:
             return []  # one job per client conn; ignore repeats
         if lower < 0 or upper >= 1 << 64:
             return []  # defense in depth; Message.unmarshal already rejects
+        if trace is None:
+            trace = _trace.new_id()  # None unless tracing is armed
         job = _Job(
             client_id=conn_id, data=data, lower=lower, upper=upper,
             tenant=tenant or f"conn:{conn_id}",
+            trace=trace, t0=now,
+        )
+        _trace.emit(
+            trace, "sched", "job_start",
+            conn=conn_id, data=data[:64], lower=lower, upper=upper,
+            tenant=tenant or f"conn:{conn_id}",
+            gaps=len(gaps) if gaps is not None else None,
         )
         if seed_best is not None:
             job.fold(seed_best[0], seed_best[1])
@@ -271,9 +293,13 @@ class Scheduler:
             # already folded into job.best (stash best / gateway seed).
             base = intersect_intervals(base, remaining)
             METRICS.inc("sched.jobs_resumed")
+            _trace.emit(
+                trace, "sched", "job_resumed", remaining=len(base)
+            )
         job.pending.extend(base)
         if job.done:  # empty range, or checkpoint/seed says fully swept
             best = job.best or (0, 0)
+            _trace.emit(trace, "sched", "job_done", instant=True)
             return [(conn_id, Message.result(best[0], best[1]))]
         self.jobs[conn_id] = job
         self._tenant_add(job.tenant, conn_id, weight)
@@ -315,6 +341,14 @@ class Scheduler:
         # The ticker's sliding-window RateMeter over this counter is the
         # health line's "recent nonces/sec" (utils/metrics.RateMeter).
         METRICS.inc("sched.nonces_swept", size)
+        # Chunk round-trip latency distribution (ISSUE 6): result-to-result
+        # gap at this miner, the same sample the EWMA rate uses.
+        METRICS.observe("hist.chunk_rtt_s", elapsed)
+        if job is not None and _trace.enabled():
+            _trace.emit(
+                job.trace, "sched", "chunk_result",
+                miner=conn_id, lo=lo, hi=hi, elapsed=round(elapsed, 6),
+            )
         if miner.queue:
             nxt = miner.queue[0]
             nxt.started_at = max(nxt.started_at, now)
@@ -344,7 +378,7 @@ class Scheduler:
                     _subtract_pending(job, front.interval)
             job.fold(hash_, nonce)
             if job.done:
-                actions.append(self._finish_job(job))
+                actions.append(self._finish_job(job, now))
         actions.extend(self._dispatch(now))
         return actions
 
@@ -390,6 +424,10 @@ class Scheduler:
                 for twin in self.jobs.values():
                     if twin.key == job.key:
                         twin.fold(*job.best)
+            _trace.emit(
+                job.trace, "sched", "job_orphaned",
+                remaining=len(remaining), had_best=job.best is not None,
+            )
             if remaining or job.best is not None:
                 _merge_progress(self._resume, job.key, job.best, remaining)
                 METRICS.inc("sched.jobs_orphaned")
@@ -436,6 +474,10 @@ class Scheduler:
             if nxt is not None:
                 nxt.started_at = max(nxt.started_at, now)
             METRICS.inc("sched.chunks_straggler_requeued")
+            _trace.emit(
+                job.trace, "sched", "straggler_requeue",
+                miner=miner.conn_id, lo=lo, hi=hi,
+            )
             self.revision += 1
             reclaimed = True
         return self._dispatch(now) if reclaimed else []
@@ -490,6 +532,10 @@ class Scheduler:
     ) -> List[Action]:
         """Invalid Result: drop it, re-queue the chunk, strike the miner."""
         METRICS.inc("sched.results_rejected")
+        _trace.emit(
+            job.trace, "sched", "chunk_reject",
+            miner=miner.conn_id, strikes=miner.rejects + 1,
+        )
         miner.rejects += 1
         front = miner.queue.popleft()
         job.remove_outstanding(miner.conn_id, front.interval)
@@ -528,11 +574,14 @@ class Scheduler:
             self._evicted.append(miner.conn_id)
         return self._dispatch(now)
 
-    def _finish_job(self, job: _Job) -> Action:
+    def _finish_job(self, job: _Job, now: float) -> Action:
         del self.jobs[job.client_id]
         self._tenant_remove(job)
         assert job.best is not None
         METRICS.inc("sched.jobs_completed")
+        _trace.emit(
+            job.trace, "sched", "job_done", elapsed=round(now - job.t0, 6)
+        )
         return (job.client_id, Message.result(job.best[0], job.best[1]))
 
     def _chunk_size(self, miner: _Miner) -> int:
@@ -621,6 +670,11 @@ class Scheduler:
                 )
                 job.outstanding.setdefault(miner.conn_id, []).append((lo, cut))
                 METRICS.inc("sched.chunks_assigned")
+                if _trace.enabled():  # hot path: attrs built only when armed
+                    _trace.emit(
+                        job.trace, "sched", "dispatch",
+                        miner=miner.conn_id, lo=lo, hi=cut,
+                    )
                 actions.append(
                     (miner.conn_id, Message.request(job.data, lo, cut))
                 )
@@ -639,6 +693,11 @@ class Scheduler:
         return out
 
     # ------------------------------------------------------------------ metrics
+
+    def vt_floor(self) -> float:
+        """The tenant WFQ's leading virtual time (telemetry gauge: the
+        serve ticker publishes it as ``gauge.sched_vt_floor``)."""
+        return self._tenants.vt_floor()
 
     def stats(self) -> Dict[str, int]:
         return {

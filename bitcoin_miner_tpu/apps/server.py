@@ -25,7 +25,8 @@ from typing import Callable, List, Optional, Tuple
 from .. import lsp
 from ..bitcoin.message import Message, MsgType
 from ..utils import sanitize
-from ..utils.metrics import RateMeter
+from ..utils import trace as trace_mod
+from ..utils.metrics import METRICS, RateMeter
 from ..utils.persist import load_json, save_json_atomic
 from .scheduler import Scheduler
 
@@ -68,6 +69,10 @@ def serve(
     # stable across reconnects (the conn id and UDP source port are not).
     accepts_client_key = cache is not None  # unguarded: setup; only Gateway carries a cache
     peer_host = getattr(server, "peer_host", None)  # transports without peer identity: per-conn keys
+    # Telemetry shape resolved at setup (before the Monitor wrap): only a
+    # Gateway carries an admission fair queue whose virtual clock the
+    # ticker publishes as a gauge.
+    has_gw_queue = hasattr(sched, "queue_vt_floor")  # unguarded: setup, ticker not started
     # The interval-algebra span store rides the same dirty-flag flush
     # cadence as the result cache (ISSUE 5).
     spans = getattr(sched, "spans", None)  # guarded-by: lock; unguarded: setup, ticker not started
@@ -94,8 +99,6 @@ def serve(
     swept_seen = [None]  # last sched.nonces_swept sample (None = first tick)
 
     def health_line() -> str:  # guarded-by: lock (callers hold the event lock)
-        from ..utils.metrics import METRICS
-
         counters = {
             k: METRICS.get(f"sched.{k}")
             for k in (
@@ -122,6 +125,16 @@ def serve(
                                    "miner.tier_downgrades", "client.resubmits"))
         }
         line = f"health {sched.stats()} {counters} nps={recent_nps.rate():.3g}"
+        # Latency distributions (ISSUE 6): request→result and chunk RTT
+        # p50/p95/p99 ride the line once samples exist, so "where does a
+        # request's time go" is visible in log.txt without a trace file.
+        for label, name in (("req", "hist.request_s"), ("chunk", "hist.chunk_rtt_s")):
+            h = METRICS.histogram(name)
+            if h is not None and h.count():
+                s = h.snapshot()
+                line += (
+                    f" {label}_lat_s={s['p50']:.3g}/{s['p95']:.3g}/{s['p99']:.3g}"
+                )
         return f"{line} extra {extra}" if extra else line
 
     def emit(actions: List[Tuple[int, Message]]) -> None:
@@ -142,8 +155,6 @@ def serve(
         while not stop.wait(tick_interval):
             try:
                 ticks += 1
-                from ..utils.metrics import METRICS
-
                 swept = METRICS.get("sched.nonces_swept")
                 if swept_seen[0] is not None and swept > swept_seen[0]:
                     recent_nps.add(swept - swept_seen[0])
@@ -158,9 +169,30 @@ def serve(
                     )
                     cache_state = cache.flush() if cache is not None else None
                     spans_state = spans.flush() if spans is not None else None
+                    st = sched.stats()
+                    vt = sched.vt_floor() if hasattr(sched, "vt_floor") else 0.0
+                    qvt = sched.queue_vt_floor() if has_gw_queue else None
                     line = (
                         health_line() if ticks % health_every == 0 else None
                     )
+                # Fleet-level gauges (ISSUE 6), published off the event
+                # lock — METRICS has its own.
+                METRICS.set_gauge("gauge.miners_live", st["miners"])
+                METRICS.set_gauge("gauge.inflight_chunks", st["outstanding_chunks"])
+                METRICS.set_gauge("gauge.admission_backlog", st.get("gw_queued", 0))
+                METRICS.set_gauge("gauge.sched_vt_floor", vt)
+                if qvt is not None:
+                    METRICS.set_gauge("gauge.gw_vt_floor", qvt)
+                # Structured-event drain (--trace=FILE): append buffered
+                # records as JSONL, file I/O outside the event lock; a
+                # no-op when tracing is off or has no sink.  Guarded like
+                # every other artifact write: a full trace disk restores
+                # its rows (Tracer.flush) and retries next tick — it must
+                # not abort the saves/sends below.
+                try:
+                    trace_mod.TRACE.flush()
+                except OSError:
+                    log.exception("trace flush failed; will retry")
                 if line is not None and line != last_health:
                     log.info("%s", line)  # skip repeats on an idle server
                     last_health = line
@@ -281,6 +313,12 @@ def serve(
                     save_checkpoint(spans_path, spans_state)
                 except OSError:
                     log.exception("final span-store flush failed")
+        # Final trace drain: events logged after the last tick must not
+        # miss the file (same contract as the cache/span final flushes).
+        try:
+            trace_mod.TRACE.flush()
+        except OSError:
+            log.exception("final trace flush failed")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -300,6 +338,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     gateway_on = False
     cache_path = None
     spans_path = None
+    # --trace=FILE arms the structured event log (utils/trace.py), drained
+    # to the file by serve()'s ticker; BMT_TRACE is the env spelling so
+    # subprocess benches (tools/fleet_bench.py) can arm it too.
+    trace_path = os.environ.get("BMT_TRACE") or None
     rate: Optional[float] = 5.0
     burst = 10.0
     max_queued = 256
@@ -307,6 +349,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     for a in argv[1:]:
         if a.startswith("--checkpoint="):
             checkpoint_path = a.split("=", 1)[1]
+        elif a.startswith("--trace="):
+            trace_path = a.split("=", 1)[1]
         elif a == "--gateway":
             gateway_on = True
         elif a.startswith("--cache="):
@@ -358,6 +402,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             print(f"unknown BMT_CHAOS_SCENARIO {scenario!r}; ignoring",
                   file=sys.stderr)
+    if trace_path:
+        from ..utils.trace import TRACE
+
+        TRACE.enable(path=trace_path)
     resume = load_checkpoint(checkpoint_path) if checkpoint_path else None
     sched = Scheduler(resume_state=resume)
     if gateway_on:

@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..apps.scheduler import Action, Interval, JobKey, Scheduler
 from ..bitcoin.message import Message, MsgType
+from ..utils import trace as _trace
 from ..utils.intervals import interval_total
 from ..utils.metrics import METRICS
 from .admission import FairQueue, TokenBucket
@@ -59,16 +60,23 @@ from .cache import ResultCache, SpanStore
 @dataclass
 class _Inflight:
     """One signature's shared sweep: the virtual id the scheduler knows it
-    by, plus every real conn waiting on the answer (arrival order)."""
+    by, plus every real conn waiting on the answer (arrival order).
+    ``trace`` is the primary waiter's event-log id (the one the scheduler
+    threads through its dispatch events); ``meta`` keeps every waiter's
+    own ``(trace id, arrival time)`` so the fan-out emits one result event
+    and one latency sample per original request (ISSUE 6)."""
 
     vid: int
     key: JobKey
     client_key: str
     waiters: List[int] = field(default_factory=list)
+    trace: Optional[int] = None
+    meta: Dict[int, Tuple[Optional[int], float]] = field(default_factory=dict)
 
 
-#: A request parked in the admission queue: (conn_id, signature, client key).
-_Queued = Tuple[int, JobKey, str]
+#: A request parked in the admission queue:
+#: (conn_id, signature, client key, trace id, enqueue time).
+_Queued = Tuple[int, JobKey, str, Optional[int], float]
 
 
 class Gateway:
@@ -162,10 +170,26 @@ class Gateway:
         key: JobKey = (data, lower, upper)
         ckey = client_key or f"conn:{conn_id}"
         METRICS.inc("gateway.requests")
+        # Trace root (ISSUE 6): ids are minted HERE, where a request
+        # enters the system, and threaded through every layer below —
+        # admission, coalescing, span planning, scheduler WFQ dispatch —
+        # so ``python -m tools.trace`` rebuilds one tree per request.
+        # new_id() returns None when tracing is off; emit is then a no-op.
+        tid = _trace.new_id()
+        _trace.emit(
+            tid, "gw", "request",
+            # data truncated: trace attrs are labels, not payload storage
+            # (same 64-char bound as the scheduler's job_start).
+            conn=conn_id, data=data[:64], lower=lower, upper=upper,
+            client=ckey,
+        )
         # 1. Solved before: answer from the cache, zero scheduler work.
         hit = self.cache.get(key)
         if hit is not None:
             METRICS.inc("gateway.cache_hits")
+            METRICS.observe("hist.request_s", 0.0)
+            _trace.emit(tid, "gw", "cache_hit")
+            _trace.emit(tid, "gw", "result", conn=conn_id, latency=0.0)
             return [(conn_id, Message.result(hit[0], hit[1]))]
         # 1b. Never seen this exact signature, but the solved spans may
         # cover it whole (a sub-range of swept work) — answer by folding
@@ -176,15 +200,18 @@ class Gateway:
         plan = None
         if lower <= upper:
             plan = self.spans.cover(data, lower, upper)
-            answer = self._span_answer(conn_id, key, plan)
+            answer = self._span_answer(conn_id, key, plan, trace=tid)
             if answer is not None:
+                METRICS.observe("hist.request_s", 0.0)
                 return [answer]
         # 2. Already sweeping: join the waiter list, share the one sweep.
         flight = self._by_key.get(key)
         if flight is not None:
             METRICS.inc("gateway.coalesced")
             flight.waiters.append(conn_id)
+            flight.meta[conn_id] = (tid, now)
             self._conn_key[conn_id] = key
+            _trace.emit(tid, "gw", "coalesce", into=flight.trace)
             return []
         # 3. Fresh signature: admit, queue, or shed.
         if len(self._by_key) >= self.max_active or not self._take_token(ckey, now):
@@ -198,14 +225,17 @@ class Gateway:
                 METRICS.inc("gateway.shed")
                 if victim is None:
                     self._shed.append(conn_id)
+                    _trace.emit(tid, "gw", "shed", conn=conn_id)
                     return []
                 self._queued_conns.discard(victim[0])
                 self._shed.append(victim[0])
+                _trace.emit(victim[3], "gw", "shed", conn=victim[0])
             METRICS.inc("gateway.throttled")
-            self._queue.push(ckey, (conn_id, key, ckey))
+            self._queue.push(ckey, (conn_id, key, ckey, tid, now))
             self._queued_conns.add(conn_id)
+            _trace.emit(tid, "gw", "queued", backlog=len(self._queue))
             return []
-        return self._submit(conn_id, key, ckey, now, plan=plan)
+        return self._submit(conn_id, key, ckey, now, plan=plan, trace=tid)
 
     def lost(self, conn_id: int, now: float = 0.0) -> List[Action]:
         key = self._conn_key.pop(conn_id, None)
@@ -213,6 +243,8 @@ class Gateway:
             flight = self._by_key.get(key)
             if flight is not None and conn_id in flight.waiters:
                 flight.waiters.remove(conn_id)
+                wtid, _t0 = flight.meta.pop(conn_id, (None, 0.0))
+                _trace.emit(wtid, "gw", "waiter_lost", conn=conn_id)
                 if not flight.waiters:
                     # Last waiter gone: cancel the shared sweep.  Through
                     # Scheduler.lost, so partial progress is stashed under
@@ -225,7 +257,14 @@ class Gateway:
             return []
         if conn_id in self._queued_conns:
             self._queued_conns.discard(conn_id)
-            self._queue.remove_where(lambda item: item[0] == conn_id)
+
+            def _dead(item: _Queued) -> bool:
+                if item[0] != conn_id:
+                    return False
+                _trace.emit(item[3], "gw", "waiter_lost", conn=conn_id)
+                return True
+
+            self._queue.remove_where(_dead)
             return []
         # A miner (or a conn we never admitted): the scheduler sorts it out.
         out = self._translate(self.sched.lost(conn_id, now), now)
@@ -251,6 +290,15 @@ class Gateway:
         out += self._shed
         self._shed = []
         return out
+
+    def vt_floor(self) -> float:
+        """Scheduler tenant WFQ leading virtual time (gauge passthrough)."""
+        return self.sched.vt_floor()
+
+    def queue_vt_floor(self) -> float:
+        """Admission fair-queue leading virtual time (the serve ticker
+        publishes it as ``gauge.gw_vt_floor``)."""
+        return self._queue.vt_floor()
 
     def stats(self) -> Dict[str, int]:
         st = self.sched.stats()
@@ -295,6 +343,8 @@ class Gateway:
         client_key: str,
         now: float,
         plan: Optional[Tuple[Optional[Tuple[int, int]], List[Interval]]] = None,
+        trace: Optional[int] = None,
+        t_req: Optional[float] = None,
     ) -> List[Action]:
         """Dispatch a fresh signature into the scheduler under a virtual id
         (tenant = the client key, so the scheduler's WFQ shares nonce
@@ -328,15 +378,20 @@ class Gateway:
         vid = self._next_vid
         self._next_vid -= 1
         flight = _Inflight(vid=vid, key=key, client_key=client_key,
-                           waiters=[conn_id])
+                           waiters=[conn_id], trace=trace)
+        flight.meta[conn_id] = (trace, t_req if t_req is not None else now)
         self._by_key[key] = flight
         self._by_vid[vid] = flight
         self._conn_key[conn_id] = key
         METRICS.inc("gateway.admitted")
+        _trace.emit(
+            trace, "gw", "submit",
+            vid=vid, gaps=len(gaps) if gaps is not None else None,
+        )
         return self._translate(
             self.sched.client_request(
                 vid, data, lower, upper, now, tenant=client_key,
-                gaps=gaps, seed_best=seed,
+                gaps=gaps, seed_best=seed, trace=trace,
             ),
             now,
         )
@@ -359,8 +414,21 @@ class Gateway:
             for waiter in flight.waiters:
                 self._conn_key.pop(waiter, None)
                 out.append((waiter, msg))
+                # One request→result latency sample and one trace terminal
+                # PER ORIGINAL REQUEST — coalesced waiters measured from
+                # their own arrival, not the primary's.
+                wtid, wt0 = flight.meta.get(waiter, (None, now))
+                latency = max(0.0, now - wt0)
+                METRICS.observe("hist.request_s", latency)
+                _trace.emit(
+                    wtid, "gw", "result",
+                    conn=waiter, latency=round(latency, 6),
+                )
             if len(flight.waiters) > 1:
                 METRICS.inc("gateway.fanout", len(flight.waiters) - 1)
+                _trace.emit(
+                    flight.trace, "gw", "fanout", waiters=len(flight.waiters)
+                )
         return out
 
     def _admit(self, now: float) -> List[Action]:
@@ -377,14 +445,19 @@ class Gateway:
             if popped is None:
                 break
             ckey, item = popped
-            conn_id, key, _ = item
-            if self._resolve_twin(item, out):
+            conn_id, key, _, tid, t_enq = item
+            if self._resolve_twin(item, out, now):
                 continue  # solved or started while it queued
             if not self._take_token(ckey, now):
                 deferred.append((ckey, item))
                 continue
             self._queued_conns.discard(conn_id)
-            out.extend(self._submit(conn_id, key, ckey, now))
+            wait = max(0.0, now - t_enq)
+            METRICS.observe("hist.admission_wait_s", wait)
+            _trace.emit(tid, "gw", "admitted", wait=round(wait, 6))
+            out.extend(
+                self._submit(conn_id, key, ckey, now, trace=tid, t_req=t_enq)
+            )
         for ckey, item in deferred:
             self._queue.push(ckey, item)
         # Even with every slot full, queued twins of an in-flight or solved
@@ -392,7 +465,9 @@ class Gateway:
         # leaving them parked a full completion cycle (the pred coalesces /
         # answers as a side effect; True removes the item from the queue).
         if len(self._queue):
-            self._queue.remove_where(lambda item: self._resolve_twin(item, out))
+            self._queue.remove_where(
+                lambda item: self._resolve_twin(item, out, now)
+            )
         return out
 
     def _span_answer(
@@ -400,6 +475,8 @@ class Gateway:
         conn_id: int,
         key: JobKey,
         plan: Optional[Tuple[Optional[Tuple[int, int]], List[Interval]]] = None,
+        trace: Optional[int] = None,
+        latency: float = 0.0,
     ) -> Optional[Action]:
         """A full-coverage interval-store answer for ``key``, or None.
         With no gaps, the fold of the overlapping spans' minima IS the
@@ -418,20 +495,35 @@ class Gateway:
             return None
         METRICS.inc("gateway.span_hits")
         METRICS.inc("gateway.nonces_saved", upper - lower + 1)
+        _trace.emit(trace, "gw", "span_hit")
+        _trace.emit(
+            trace, "gw", "result", conn=conn_id, latency=round(latency, 6)
+        )
         self.cache.put(key, best[0], best[1])
         return (conn_id, Message.result(best[0], best[1]))
 
-    def _resolve_twin(self, item: _Queued, out: List[Action]) -> bool:
-        conn_id, key, _ = item
+    def _resolve_twin(
+        self, item: _Queued, out: List[Action], now: float = 0.0
+    ) -> bool:
+        conn_id, key, _, tid, t_enq = item
         hit = self.cache.get(key)
         if hit is not None:
             self._queued_conns.discard(conn_id)
             METRICS.inc("gateway.cache_hits")
+            METRICS.observe("hist.request_s", max(0.0, now - t_enq))
+            _trace.emit(tid, "gw", "cache_hit")
+            _trace.emit(
+                tid, "gw", "result",
+                conn=conn_id, latency=round(max(0.0, now - t_enq), 6),
+            )
             out.append((conn_id, Message.result(hit[0], hit[1])))
             return True
-        answer = self._span_answer(conn_id, key)
+        answer = self._span_answer(
+            conn_id, key, trace=tid, latency=max(0.0, now - t_enq)
+        )
         if answer is not None:
             self._queued_conns.discard(conn_id)
+            METRICS.observe("hist.request_s", max(0.0, now - t_enq))
             out.append(answer)
             return True
         flight = self._by_key.get(key)
@@ -439,6 +531,8 @@ class Gateway:
             self._queued_conns.discard(conn_id)
             METRICS.inc("gateway.coalesced")
             flight.waiters.append(conn_id)
+            flight.meta[conn_id] = (tid, t_enq)
             self._conn_key[conn_id] = key
+            _trace.emit(tid, "gw", "coalesce", into=flight.trace)
             return True
         return False

@@ -16,6 +16,7 @@ from concurrent.futures import CancelledError as FutureCancelledError
 from concurrent.futures import Future
 from typing import Any, Callable, Coroutine, Optional, Tuple
 
+from ..utils import trace
 from .aio import AsyncClient, AsyncServer
 from .errors import ConnClosedError
 from .params import Params
@@ -109,6 +110,13 @@ class Client:
         except BaseException:
             self._lt.stop()
             raise
+        # Conn-lifecycle trace events (ISSUE 6): in a chaos soak's trace
+        # the connect/close pairs bracket each reconnect epoch, so the
+        # reconstructor can attribute retransmit bursts to a conn.
+        trace.emit(
+            None, "lsp", "connect",
+            conn=self._c.conn_id, label=label, host=host, port=port,
+        )
 
     def conn_id(self) -> int:
         return self._c.conn_id
@@ -123,6 +131,7 @@ class Client:
     def close(self) -> None:
         """Block until pending sends are acked (or the conn is lost).
         Idempotent: a second close is a no-op."""
+        trace.emit(None, "lsp", "close", conn=self._c.conn_id)
         try:
             self._lt.run(self._c.close())
         except ConnClosedError:

@@ -436,7 +436,7 @@ class NetSim:
 
     def _count(self, what: str) -> None:  # guarded-by: _lock
         self._counters[what] = self._counters.get(what, 0) + 1
-        METRICS.inc(f"chaos.{what}")
+        METRICS.inc(f"chaos.{what}")  # metric-ok: chaos.*
 
     def _note(self, key, direction, what, decision):  # guarded-by: _lock
         self._count(what)

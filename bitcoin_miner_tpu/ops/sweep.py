@@ -24,6 +24,7 @@ Decomposition invariants:
 from __future__ import annotations
 
 import collections
+import time as _time
 from dataclasses import dataclass
 from functools import lru_cache
 from typing import Deque, Iterator, List, Optional, Sequence, Tuple
@@ -32,6 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import trace as _trace
+from ..utils.metrics import METRICS
 from ..utils.platform import is_tpu
 from .sha256 import DigitPos, MsgLayout, build_layout, compress, compress_rolled
 
@@ -679,11 +682,13 @@ class SweepPipeline:
                 # Class lock: a cold class traces inside this call; holding
                 # the lock shares that build with a concurrent prewarm of
                 # the same class.  Warm classes just enqueue (~ms) so the
-                # lock is uncontended in steady state.
+                # lock is uncontended in steady state.  The enqueue stamp
+                # rides with the handle so the fetcher can report each
+                # dispatch's enqueue→fetch time (hist.device_dispatch_s).
                 with self._class_lock(kern):
                     out = self._invoke(kern, midstate, tail_const, bounds)
                     self._warm_keys.add(getattr(kern, "class_key", kern))
-                    return out
+                    return (out, _time.monotonic())
 
             def consume(out, bases, n_lanes) -> None:
                 # Blocks when max_inflight results are unfetched — that's
@@ -739,14 +744,26 @@ class SweepPipeline:
                     best[:] = [cand]
                 continue
             try:
-                if len(out) == 4:  # mesh mode: (h0, h1, device, flat)
-                    h0, h1, dev, flat_idx = out
+                handles, t_enq = out  # run_kernel stamped the enqueue
+                if len(handles) == 4:  # mesh mode: (h0, h1, device, flat)
+                    h0, h1, dev, flat_idx = handles
                     fi = int(flat_idx)  # blocks until the dispatch lands
                     row = int(dev) * self._per_dev_batch + fi // n_lanes
                 else:
-                    h0, h1, flat_idx = out
+                    h0, h1, flat_idx = handles
                     fi = int(flat_idx)
                     row = fi // n_lanes
+                # Per-dispatch device time (ISSUE 6): enqueue→fetched.
+                # The fetch above blocked until the device finished this
+                # dispatch, so the delta is queue + kernel time — the
+                # number adaptive chunking needs per shape class.
+                dt = _time.monotonic() - t_enq
+                METRICS.observe("hist.device_dispatch_s", dt)
+                if _trace.enabled():
+                    _trace.emit(
+                        None, "kernel", "dispatch_done",
+                        rows=len(bases), lanes=n_lanes, dt=round(dt, 6),
+                    )
                 if fi != I32_MAX:
                     h = (int(h0) << 32) | int(h1)
                     cand = (h, bases[row] + fi % n_lanes)

@@ -1,25 +1,140 @@
-"""Process-wide counters — the observability layer (SURVEY §5).
+"""Process-wide counters, histograms and gauges — the observability layer.
 
 The reference has only debug prints; the survey's rebuild note asks for
 "structured logging plus a handful of counters (nonces/sec, retransmits,
-live miners)".  This is that: a tiny lock-protected counter registry that
-every layer increments and anything (server log, runner stderr, tests) can
-snapshot.  Deliberately not a metrics *server* — parity plus a little, not
-an ops stack.
+live miners)".  This is that, grown three ways (ISSUE 6):
+
+- **counters** — the original lock-protected registry every layer
+  increments and anything (server log, runner stderr, tests) snapshots;
+- **histograms** (:class:`Histogram`) — fixed log-bucket latency
+  distributions (mergeable, p50/p95/p99) for request→result latency,
+  chunk round-trips, admission queue wait and per-dispatch kernel time,
+  so a bench artifact finally has a latency axis next to jobs/s;
+- **gauges** — point-in-time levels (live miners, in-flight chunks,
+  admission backlog, WFQ virtual clocks) set by the serve ticker.
+
+Structured per-request *event* tracing lives in utils/trace.py; this
+module stays the aggregate view.  Every name used anywhere MUST appear in
+the registry block above ``METRICS`` below — ``python -m tools.analyze``'s
+``metrics`` pass fails the build on drift in either direction.
 """
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import defaultdict, deque
 from typing import Deque, Dict, Optional, Tuple
+
+#: Histogram bucket growth factor: 4 buckets per octave (~19% wide), so a
+#: quantile estimate is within one bucket (×1.19) of the true sample
+#: quantile.  Module-level constant — every histogram shares the same
+#: boundaries, which is what makes them mergeable.
+_GROWTH_LOG2 = 0.25  # bucket i covers [2**(i/4), 2**((i+1)/4))
+
+
+class Histogram:
+    """Fixed log-bucket histogram of non-negative samples (latencies).
+
+    Buckets are powers of ``2**0.25`` keyed by integer index, so two
+    histograms built anywhere merge by adding counts (associative and
+    commutative by construction).  ``quantile(q)`` returns the upper edge
+    of the bucket holding the q-th sample: the true sample quantile lies
+    within one bucket width below it.  Thread-safe (own lock) — miners,
+    gateway and LSP loops all observe into the shared registry."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._buckets: Dict[int, int] = defaultdict(int)  # guarded-by: _lock
+        self._zero = 0  # samples <= 0 (instant answers)  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+        self._sum = 0.0  # guarded-by: _lock
+
+    @staticmethod
+    def _index(value: float) -> int:
+        return math.floor(math.log2(value) / _GROWTH_LOG2)
+
+    @staticmethod
+    def _upper_edge(index: int) -> float:
+        return 2.0 ** ((index + 1) * _GROWTH_LOG2)
+
+    def observe(self, value: float, n: int = 1) -> None:
+        with self._lock:
+            self._count += n
+            if value <= 0.0:
+                self._zero += n
+            else:
+                self._sum += value * n
+                self._buckets[self._index(value)] += n
+
+    def merge(self, other: "Histogram") -> None:
+        """Fold ``other``'s samples into self (other is snapshotted under
+        its own lock first, so cross-thread merges are safe)."""
+        with other._lock:
+            buckets = dict(other._buckets)
+            zero, count, total = other._zero, other._count, other._sum
+        with self._lock:
+            for i, c in buckets.items():
+                self._buckets[i] += c
+            self._zero += zero
+            self._count += count
+            self._sum += total
+
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._count if self._count else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Upper bucket edge of the q-th sample (0 for an empty histogram
+        or a quantile landing in the zero bucket)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            if self._count == 0:
+                return 0.0
+            # Rank of the q-th sample, 1-based, clamped to the population.
+            rank = min(self._count, max(1, math.ceil(q * self._count)))
+            if rank <= self._zero:
+                return 0.0
+            seen = self._zero
+            for i in sorted(self._buckets):
+                seen += self._buckets[i]
+                if seen >= rank:
+                    return self._upper_edge(i)
+            return self._upper_edge(max(self._buckets))  # float-slack guard
+
+    def snapshot(self) -> Dict[str, float]:
+        """The health-line / bench-JSON view: count, mean, p50/p95/p99."""
+        return {
+            "count": float(self.count()),
+            "mean": self.mean(),
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+        }
+
+    def buckets(self) -> Dict[int, int]:
+        """Bucket-index -> count (the merge/property-test surface); the
+        zero bucket is exposed separately via :meth:`zero_count`."""
+        with self._lock:
+            return dict(self._buckets)
+
+    def zero_count(self) -> int:
+        with self._lock:
+            return self._zero
 
 
 class Metrics:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = defaultdict(int)  # guarded-by: _lock
+        self._hists: Dict[str, Histogram] = {}  # guarded-by: _lock
+        self._gauges: Dict[str, float] = {}  # guarded-by: _lock
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -29,19 +144,75 @@ class Metrics:
         with self._lock:
             return self._counters.get(name, 0)  # no defaultdict insert on read
 
-    def snapshot(self) -> Dict[str, int]:
+    # ------------------------------------------------------------ histograms
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample into the named histogram (created on first
+        use).  The histogram has its own lock, so the registry lock is
+        held only for the dict lookup."""
         with self._lock:
-            return dict(self._counters)
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+        h.observe(value)
+
+    def histogram(self, name: str) -> Optional[Histogram]:
+        with self._lock:
+            return self._hists.get(name)
+
+    def histograms(self) -> Dict[str, Histogram]:
+        with self._lock:
+            return dict(self._hists)
+
+    # ---------------------------------------------------------------- gauges
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def gauge(self, name: str) -> float:
+        with self._lock:
+            return self._gauges.get(name, 0.0)
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
+    # -------------------------------------------------------------- snapshot
+
+    def snapshot(self, dists: bool = False) -> Dict:
+        """Counters by default (the delta-friendly view every bench and
+        drill diffs).  ``dists=True`` adds the distributions: gauges under
+        their own names and each histogram's ``snapshot()`` dict — the
+        operator/bench view (ISSUE 6)."""
+        with self._lock:
+            out: Dict = dict(self._counters)
+            if not dists:
+                return out
+            gauges = dict(self._gauges)
+            hists = dict(self._hists)
+        out.update(gauges)
+        for name, h in hists.items():
+            out[name] = h.snapshot()
+        return out
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
+            self._hists.clear()
+            self._gauges.clear()
 
 
-#: The process-wide registry.  Known counters:
+#: The process-wide registry.  EVERY name used anywhere must be listed
+#: here and vice versa — the ``metrics`` analyzer pass
+#: (tools/analyze/metriccheck.py) fails the build on drift in either
+#: direction.  Kinds by prefix: ``hist.*`` are histograms (observe),
+#: ``gauge.*`` are gauges (set_gauge), everything else is a counter (inc).
+#:
 #:   lsp.retransmits       data messages resent on epoch ticks
 #:   lsp.delivered         in-order payloads handed to the application
 #:   lsp.dropped_bad_size  datagrams rejected by Size validation
+#:   lsp.dropped_horizon   datagrams beyond the reorder horizon (DoS guard)
 #:   sched.chunks_assigned     chunks handed to miners
 #:   sched.chunks_reassigned   chunks returned by dead miners
 #:   sched.chunks_straggler_requeued  chunks reclaimed from hung miners
@@ -60,6 +231,10 @@ class Metrics:
 #:   gateway.fanout            extra conns served by a coalesced Result
 #:   gateway.throttled         Requests queued by admission control
 #:   gateway.shed              Requests dropped on backlog overflow (conn closed)
+#:   gateway.span_hits         requests answered whole from solved spans
+#:   gateway.span_partial      requests that swept only their uncovered gaps
+#:   gateway.nonces_saved      nonces answered from spans instead of swept
+#:   gateway.span_evictions    span-store data keys dropped by the LRU bound
 #:   miner.nonces              nonces swept by this process's miner loop
 #:   miner.reconnects          successful re-Joins after a lost server conn
 #:   miner.tier_downgrades     kernel tiers abandoned by the sweep watchdog
@@ -69,6 +244,17 @@ class Metrics:
 #:   chaos.duplicated          packets the simulator emitted twice
 #:   chaos.reordered           packets given the reorder extra delay
 #:   chaos.delayed             packets delivered late (delay/jitter/reorder)
+#:   hist.request_s            request→result latency at the gateway (s)
+#:   hist.chunk_rtt_s          chunk dispatch→Result round-trip (s)
+#:   hist.admission_wait_s     admission-queue wait before dispatch (s)
+#:   hist.device_dispatch_s    per-dispatch device enqueue→fetch time (s)
+#:   hist.miner_chunk_s        miner-side chunk submit→solve time (s)
+#:   hist.lsp_rtt_s            LSP data→ack round-trip, Karn-filtered (s)
+#:   gauge.miners_live         miners currently joined to the scheduler
+#:   gauge.inflight_chunks     chunks outstanding at miners right now
+#:   gauge.admission_backlog   requests parked in the admission queue
+#:   gauge.sched_vt_floor      scheduler tenant WFQ leading virtual time
+#:   gauge.gw_vt_floor         gateway admission WFQ leading virtual time
 METRICS = Metrics()
 
 

@@ -69,6 +69,15 @@ class VirtualClockWFQ:
     def key_count(self) -> int:
         return len(self._principals)
 
+    def vt_floor(self) -> float:
+        """The minimum active virtual time — the clock's leading edge
+        (0.0 when idle).  Telemetry only (the WFQ virtual-clock gauges,
+        ISSUE 6); selection never reads it."""
+        return min(
+            (p.vt for p in self._principals.values() if p.items),
+            default=0.0,
+        )
+
     def principals(self) -> Iterator[Principal]:
         return iter(self._principals.values())
 

@@ -1,0 +1,33 @@
+"""Seeded metric-registry drift for the ``metrics`` pass
+(tools/analyze/metriccheck.py) — every rule must fire on this file:
+
+- ``fixture.documented_only`` is documented below but never emitted
+  (``metric-unused``);
+- ``fixture.never_documented`` is emitted but absent from the registry
+  block (``metric-undocumented``);
+- ``hist.fixture_latency`` is documented as a histogram but emitted via
+  ``inc`` (``metric-kind-mismatch``);
+- the computed-name ``inc`` cannot be registry-checked at all
+  (``metric-dynamic-name``).
+"""
+
+
+class Metrics:  # stand-in so the fixture never imports the real package
+    def inc(self, name, n=1):
+        pass
+
+    def observe(self, name, value):
+        pass
+
+
+#: The fixture's registry block (same format as utils/metrics.py: the
+#: contiguous ``#:`` lines directly above the METRICS assignment).
+#:   fixture.documented_only   documented here, emitted nowhere
+#:   hist.fixture_latency      a histogram name (observe-only kind)
+METRICS = Metrics()
+
+
+def provoke_metric_drift(suffix: str) -> None:
+    METRICS.inc("fixture.never_documented")  # undocumented counter
+    METRICS.inc("hist.fixture_latency")  # wrong emitter for a hist.* name
+    METRICS.inc("fixture." + suffix)  # dynamic name: unverifiable

@@ -48,7 +48,9 @@ def _pass_findings(name, root, scan=None):
 # --------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("name", ["lock", "wfq", "trace", "contracts", "sanitize"])
+@pytest.mark.parametrize(
+    "name", ["lock", "wfq", "trace", "contracts", "sanitize", "metrics"]
+)
 def test_repo_is_clean(name):
     scan = TRACE_SCAN_DIRS if name == "trace" else DEFAULT_SCAN_DIRS
     findings = _pass_findings(name, REPO, scan)
@@ -80,7 +82,8 @@ def test_cli_fixture_mode_exits_nonzero():
     )
     assert res.returncode == 1, res.stdout + res.stderr
     # Every pass contributed at least one finding to the output.
-    for tag in ("[lock/", "[wfq/", "[contracts/", "[trace/", "[sanitize/"):
+    for tag in ("[lock/", "[wfq/", "[contracts/", "[trace/", "[sanitize/",
+                "[metrics/"):
         assert tag in res.stdout, f"{tag} never fired:\n{res.stdout}"
 
 
@@ -164,6 +167,54 @@ def test_sanitize_pass_fires_on_fixture():
         "provoke_unsynchronized_access",
         "provoke_lock_order_inversion",
     } <= provoked
+
+
+def test_metrics_rules_fire_on_fixture():
+    """Every metric-registry rule fires on bad_metric.py: an emitted-but-
+    undocumented name, a documented-but-never-emitted name, a histogram
+    name emitted via inc(), and a computed (unverifiable) name."""
+    findings = _pass_findings("metrics", FIXTURES)
+    rules = _rules(findings)
+    assert {
+        "metric-undocumented",
+        "metric-unused",
+        "metric-kind-mismatch",
+        "metric-dynamic-name",
+    } <= rules
+    symbols = {f.symbol for f in findings}
+    assert "fixture.never_documented" in symbols
+    assert "fixture.documented_only" in symbols
+    assert "hist.fixture_latency" in symbols
+
+
+def test_metrics_pass_honors_metric_ok_declaration(tmp_path):
+    """A dynamic emit with `# metric-ok: prefix.*` is legal and marks the
+    documented prefix as emitted (the chaos layer's one dynamic site);
+    declaring an unknown name still fails."""
+    good = tmp_path / "dyn_ok.py"
+    good.write_text(
+        "class Metrics:\n"
+        "    def inc(self, name):\n"
+        "        pass\n"
+        "\n"
+        "#: registry block\n"
+        "#:   dyn.alpha   covered by the declared glob\n"
+        "#:   dyn.beta    covered by the declared glob\n"
+        "METRICS = Metrics()\n"
+        "\n"
+        "def emit(what):\n"
+        "    METRICS.inc('dyn.' + what)  # metric-ok: dyn.*\n"
+    )
+    assert _pass_findings("metrics", tmp_path) == []
+    bad = tmp_path / "dyn_ok.py"
+    bad.write_text(
+        bad.read_text().replace("# metric-ok: dyn.*",
+                                "# metric-ok: dyn.alpha dyn.gamma")
+    )
+    findings = _pass_findings("metrics", tmp_path)
+    rules_syms = {(f.rule, f.symbol) for f in findings}
+    assert ("metric-undocumented", "dyn.gamma") in rules_syms  # bad token
+    assert ("metric-unused", "dyn.beta") in rules_syms  # no longer covered
 
 
 def test_trace_pass_does_not_flag_static_branches(tmp_path):

@@ -1,0 +1,550 @@
+"""End-to-end request tracing + latency telemetry (ISSUE 6).
+
+- Histogram unit/property tests: merge associativity, quantile bounds
+  against sorted samples, the zero bucket.
+- Tracer primitives: off-path no-op, ring overflow accounting, JSONL
+  flush round-trip.
+- Event-level trace propagation through gateway + scheduler: full sweep,
+  coalescing fan-out, span-store partial-coverage planning, admission
+  queue wait, shed, orphan/resume — each yields exactly one complete
+  tree per original request, no orphan spans.
+- Tier-1 e2e: a loopback fleet served with tracing armed, its file
+  reconstructed by ``python -m tools.trace --json --strict`` into
+  complete timelines with non-zero stage durations; and a seeded chaos
+  drill whose trace reconstructs with no orphan spans.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:  # make `tools.trace` importable in-process
+    sys.path.insert(0, str(REPO))
+
+from tools.trace import RequestTree, build, load  # noqa: E402
+from tools.trace.__main__ import main as trace_main  # noqa: E402
+
+from bitcoin_miner_tpu.apps.scheduler import Scheduler
+from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+from bitcoin_miner_tpu.gateway import Gateway, ResultCache, SpanStore
+from bitcoin_miner_tpu.utils import trace
+from bitcoin_miner_tpu.utils.metrics import METRICS, Histogram, Metrics
+
+pytestmark = pytest.mark.trace
+
+_GROWTH = 2 ** 0.25  # the histogram bucket growth factor
+
+
+@pytest.fixture(autouse=True)
+def _tracer_hygiene():
+    """Every test starts and ends with tracing disarmed and drained."""
+    trace.TRACE.disable()
+    trace.TRACE.drain()
+    yield
+    trace.TRACE.disable()
+    trace.TRACE.drain()
+
+
+# --------------------------------------------------------------------------
+# 1. Histogram properties
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [1, 7, 42])
+def test_histogram_quantile_bounded_by_sorted_samples(seed):
+    """The estimate is the upper edge of the bucket holding the q-th
+    sample, so: true quantile <= estimate < true quantile * growth."""
+    rng = random.Random(seed)
+    samples = [rng.lognormvariate(0.0, 2.0) for _ in range(500)]
+    h = Histogram()
+    for s in samples:
+        h.observe(s)
+    ordered = sorted(samples)
+    for q in (0.01, 0.5, 0.9, 0.95, 0.99, 1.0):
+        true = ordered[min(len(ordered) - 1, max(0, -(-int(q * 500)) - 1))]
+        est = h.quantile(q)
+        assert true <= est * (1 + 1e-12), (q, true, est)
+        assert est <= true * _GROWTH * (1 + 1e-9), (q, true, est)
+
+
+def test_histogram_merge_is_associative_and_commutative():
+    rng = random.Random(3)
+    parts = []
+    for _ in range(3):
+        h = Histogram()
+        for _ in range(200):
+            h.observe(rng.expovariate(1.0))
+        h.observe(0.0)  # exercise the zero bucket through merges too
+        parts.append(h)
+
+    def merged(order):
+        out = Histogram()
+        for i in order:
+            out.merge(parts[i])
+        return out
+
+    a = merged([0, 1, 2])
+    b = merged([2, 0, 1])
+    c = Histogram()
+    ab = Histogram()
+    ab.merge(parts[0])
+    ab.merge(parts[1])
+    c.merge(ab)
+    c.merge(parts[2])
+    for other in (b, c):
+        assert a.buckets() == other.buckets()
+        assert a.zero_count() == other.zero_count()
+        assert a.count() == other.count()
+        for q in (0.5, 0.95, 0.99):
+            assert a.quantile(q) == other.quantile(q)
+
+
+def test_histogram_zero_bucket_and_empty():
+    h = Histogram()
+    assert h.quantile(0.5) == 0.0  # empty
+    h.observe(0.0)
+    h.observe(-1.0)  # clamped into the zero bucket, not an error
+    assert h.count() == 2
+    assert h.zero_count() == 2
+    assert h.quantile(0.99) == 0.0
+    h.observe(4.0)
+    assert h.quantile(0.5) == 0.0  # rank 2 of 3 still in the zero bucket
+    assert h.quantile(1.0) >= 4.0
+
+
+def test_histogram_mean_and_snapshot_shape():
+    h = Histogram()
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+    assert h.mean() == pytest.approx(2.0)
+    snap = h.snapshot()
+    assert set(snap) == {"count", "mean", "p50", "p95", "p99"}
+    assert snap["count"] == 3.0
+
+
+def test_metrics_snapshot_dists_view():
+    m = Metrics()
+    m.inc("a", 2)
+    m.set_gauge("gauge.x", 1.5)
+    m.observe("hist.y", 0.25)
+    assert m.snapshot() == {"a": 2}  # default view: counters only
+    full = m.snapshot(dists=True)
+    assert full["a"] == 2
+    assert full["gauge.x"] == 1.5
+    assert full["hist.y"]["count"] == 1.0
+    m.reset()
+    assert m.snapshot(dists=True) == {}
+
+
+# --------------------------------------------------------------------------
+# 2. Tracer primitives
+# --------------------------------------------------------------------------
+
+
+def test_emit_is_noop_when_disabled():
+    assert not trace.enabled()
+    assert trace.new_id() is None
+    trace.emit(1, "gw", "request", conn=1)
+    trace.TRACE.record(1, "gw", "request")  # direct record still lands...
+    assert len(trace.TRACE.drain()) == 1  # ...but emit() above did not
+
+
+def test_tracer_flush_jsonl_roundtrip(tmp_path):
+    path = tmp_path / "t.jsonl"
+    with trace.tracing(str(path)):
+        tid = trace.new_id()
+        assert tid is not None
+        trace.emit(tid, "gw", "request", data="x")
+        trace.emit(None, "miner", "reconnect")
+    rows = load(str(path))
+    assert [r["event"] for r in rows] == ["request", "reconnect"]
+    assert rows[0]["trace"] == tid and rows[1]["trace"] is None
+    assert rows[0]["attrs"] == {"data": "x"}
+
+
+def test_tracer_ring_overflow_drops_oldest_and_counts():
+    trace.TRACE.enable(capacity=4)
+    try:
+        for i in range(10):
+            trace.emit(None, "s", f"e{i}")
+        assert trace.TRACE.dropped() == 6
+        rows = trace.TRACE.drain()
+        assert [r["event"] for r in rows] == ["e6", "e7", "e8", "e9"]
+    finally:
+        trace.TRACE.disable()
+
+
+def test_tracer_partial_write_failure_neither_loses_nor_duplicates(
+    tmp_path, monkeypatch
+):
+    """A flush that fails MID-append (e.g. ENOSPC) must restore exactly
+    the rows not yet durable: the retry may not duplicate already-written
+    events, and the torn final line must not corrupt the next row."""
+    import os as _os
+
+    path = tmp_path / "torn.jsonl"
+    t = trace.Tracer()
+    t.enable(path=str(path))
+    for i in range(5):
+        t.record(None, "s", f"e{i}")
+    real_write = _os.write
+    budget = [30]  # ~one row, then the disk "fills"
+
+    def failing_write(fd, data):
+        if budget[0] <= 0:
+            raise OSError(28, "No space left on device")
+        n = min(budget[0], len(data))
+        budget[0] -= n
+        return real_write(fd, data[:n])
+
+    monkeypatch.setattr(_os, "write", failing_write)
+    with pytest.raises(OSError):
+        t.flush()
+    monkeypatch.setattr(_os, "write", real_write)
+    t.flush()  # disk healthy again: exactly the unwritten suffix lands
+    t.disable()
+    assert [r["event"] for r in load(str(path))] == [
+        f"e{i}" for i in range(5)
+    ]
+
+
+def test_tracer_flush_appends_across_calls(tmp_path):
+    path = tmp_path / "t.jsonl"
+    trace.TRACE.enable(path=str(path))
+    try:
+        trace.emit(None, "s", "one")
+        assert trace.TRACE.flush() == 1
+        trace.emit(None, "s", "two")
+        assert trace.TRACE.flush() == 1
+        assert trace.TRACE.flush() == 0  # nothing buffered: no-op
+    finally:
+        trace.TRACE.disable()
+    assert [r["event"] for r in load(str(path))] == ["one", "two"]
+
+
+# --------------------------------------------------------------------------
+# 3. Event-level propagation (gateway + scheduler, no sockets)
+# --------------------------------------------------------------------------
+
+
+def _gateway(**kw):
+    kw.setdefault("rate", None)
+    return Gateway(Scheduler(min_chunk=100), **kw)
+
+
+def _solve(gw, miner, now):
+    """Answer the miner's outstanding chunks (front job's data, so a job
+    admitted from the queue mid-loop is answered correctly too) until the
+    miner idles."""
+    out = []
+    for _ in range(64):
+        m = gw.sched.miners.get(miner)
+        if m is None or not m.queue:
+            break
+        front = m.queue[0]
+        job = gw.sched.jobs.get(front.job)
+        if job is None:
+            break
+        c_lo, c_hi = front.interval
+        h, n = min_hash_range(job.data, c_lo, c_hi)
+        out += gw.result(miner, h, n, now)
+    return out
+
+
+def test_full_sweep_yields_one_complete_tree():
+    trace.TRACE.enable()
+    gw = _gateway()
+    gw.miner_joined(1, 0.0)
+    gw.client_request(10, "d", 0, 299, 1.0)
+    acts = _solve(gw, 1, 2.0)
+    assert any(a[0] == 10 for a in acts)  # the Result reached the client
+    report = build(trace.TRACE.drain())
+    assert report.orphans == []
+    assert len(report.trees) == 1
+    (tree,) = report.trees.values()
+    assert tree.kind == "swept" and tree.complete
+    assert tree.signature() == ("d", 0, 299)
+    stages = tree.stages()
+    assert "sweep" in stages and stages["sweep"] >= 0.0
+    assert len(tree.chunks()) >= 1
+    assert all(c["elapsed"] is not None for c in tree.chunks())
+
+
+def test_coalesced_twin_gets_linked_complete_tree():
+    trace.TRACE.enable()
+    before = _hist_count("hist.request_s")
+    gw = _gateway()
+    gw.miner_joined(1, 0.0)
+    gw.client_request(10, "d", 0, 199, 1.0)
+    gw.client_request(11, "d", 0, 199, 1.2)  # twin: coalesces
+    _solve(gw, 1, 2.0)
+    report = build(trace.TRACE.drain())
+    assert report.orphans == [] and len(report.trees) == 2
+    kinds = {t.kind for t in report.trees.values()}
+    assert kinds == {"swept", "coalesced"}
+    assert all(t.complete for t in report.trees.values())
+    twin = next(t for t in report.trees.values() if t.kind == "coalesced")
+    primary = next(t for t in report.trees.values() if t.kind == "swept")
+    link = twin._find("gw", "coalesce")
+    assert link is not None and link["attrs"]["into"] == primary.trace
+    # One latency sample per ORIGINAL request.
+    assert _hist_count("hist.request_s") - before == 2
+
+
+def test_span_partial_coverage_traced_through_submit():
+    trace.TRACE.enable()
+    gw = _gateway()
+    gw.miner_joined(1, 0.0)
+    h, n = min_hash_range("d", 0, 149)
+    gw.spans.add("d", 0, 149, h, n)  # half of [0, 299] already solved
+    gw.client_request(10, "d", 0, 299, 1.0)
+    _solve(gw, 1, 2.0)
+    report = build(trace.TRACE.drain())
+    (tree,) = report.trees.values()
+    assert tree.complete and tree.kind == "swept"
+    submit = tree._find("gw", "submit")
+    assert submit is not None and submit["attrs"]["gaps"] == 1
+    start = tree._find("sched", "job_start")
+    assert start is not None and start["attrs"]["gaps"] == 1
+
+
+def test_span_full_coverage_answers_as_span_hit():
+    trace.TRACE.enable()
+    gw = _gateway()
+    h, n = min_hash_range("d", 0, 99)
+    gw.spans.add("d", 0, 99, h, n)
+    # A strict sub-range containing the span's argmin is answerable with
+    # zero device work (the ISSUE 5 rule) — and must trace as span_hit.
+    lo, hi = max(0, n - 5), min(99, n + 5)
+    acts = gw.client_request(10, "d", lo, hi, 1.0)
+    assert acts == [(10, acts[0][1])] and acts[0][1].nonce == n
+    report = build(trace.TRACE.drain())
+    (tree,) = report.trees.values()
+    assert tree.complete and tree.kind == "span_hit"
+    assert tree._find("gw", "result") is not None
+
+
+def test_admission_queue_wait_is_traced_and_observed():
+    trace.TRACE.enable()
+    before = _hist_count("hist.admission_wait_s")
+    gw = _gateway(max_active=1)
+    gw.miner_joined(1, 0.0)
+    gw.client_request(10, "a", 0, 199, 1.0)  # takes the one active slot
+    gw.client_request(11, "b", 0, 199, 1.5)  # parked in the queue
+    _solve(gw, 1, 3.5)  # completing "a" admits the parked "b" too
+    report = build(trace.TRACE.drain())
+    assert report.orphans == []
+    parked = next(
+        t for t in report.trees.values() if t.signature()[0] == "b"
+    )
+    assert parked.complete and parked.kind == "swept"
+    queued = parked._find("gw", "queued")
+    admitted = parked._find("gw", "admitted")
+    assert queued is not None and admitted is not None
+    assert admitted["attrs"]["wait"] >= 0.0
+    assert "admission" in parked.stages()
+    assert _hist_count("hist.admission_wait_s") - before == 1
+
+
+def test_shed_request_tree_is_closed_not_orphaned():
+    trace.TRACE.enable()
+    gw = _gateway(max_active=1, max_queued=0)
+    gw.miner_joined(1, 0.0)
+    gw.client_request(10, "a", 0, 199, 1.0)
+    gw.client_request(11, "b", 0, 199, 1.1)  # no slot, no queue: shed
+    assert 11 in gw.drain_evictions()
+    report = build(trace.TRACE.drain())
+    shed = next(t for t in report.trees.values() if t.kind == "shed")
+    assert shed.complete  # terminal: gw.shed
+
+
+def test_orphaned_job_and_resubmit_are_two_closed_trees():
+    """Client retry-with-resubmit: the original request's tree terminates
+    in job_orphaned, the resubmission mints a FRESH tree that resumes —
+    one tree per original request, none left open."""
+    trace.TRACE.enable()
+    sched = Scheduler(min_chunk=100)
+    sched.miner_joined(1, 0.0)
+    sched.client_request(10, "d", 0, 399, 1.0)
+    # One chunk lands, then the client dies mid-job.
+    m = sched.miners[1]
+    c_lo, c_hi = m.queue[0].interval
+    h, n = min_hash_range("d", c_lo, c_hi)
+    sched.result(1, h, n, 1.5)
+    sched.lost(10, 2.0)
+    # The reconnected client resubmits the identical signature.
+    sched.client_request(20, "d", 0, 399, 3.0)
+    for _ in range(64):
+        if not sched.miners[1].queue:
+            break
+        c_lo, c_hi = sched.miners[1].queue[0].interval
+        h, n = min_hash_range("d", c_lo, c_hi)
+        sched.result(1, h, n, 4.0)
+    report = build(trace.TRACE.drain())
+    assert report.orphans == [] and len(report.trees) == 2
+    by_kind = sorted(t.kind for t in report.trees.values())
+    assert by_kind == ["lost", "swept"]
+    assert all(t.complete for t in report.trees.values())
+    resumed = next(t for t in report.trees.values() if t.kind == "swept")
+    assert resumed._find("sched", "job_resumed") is not None
+
+
+def test_waiter_death_closes_its_tree():
+    trace.TRACE.enable()
+    gw = _gateway()
+    gw.miner_joined(1, 0.0)
+    gw.client_request(10, "d", 0, 199, 1.0)
+    gw.client_request(11, "d", 0, 199, 1.2)  # coalesced twin
+    gw.lost(11, 1.5)  # twin dies while parked on the shared sweep
+    _solve(gw, 1, 2.0)
+    report = build(trace.TRACE.drain())
+    assert report.orphans == []
+    assert all(t.complete for t in report.trees.values())
+    twin = next(t for t in report.trees.values() if t.kind == "coalesced")
+    assert twin._find("gw", "waiter_lost") is not None
+
+
+def test_reconstructor_reports_orphan_spans():
+    rows = [
+        {"t": 1.0, "trace": 99, "span": "sched", "event": "dispatch",
+         "attrs": {"miner": 1, "lo": 0, "hi": 9}},
+        {"t": 2.0, "trace": 1, "span": "gw", "event": "request",
+         "attrs": {"data": "d", "lower": 0, "upper": 9}},
+    ]
+    report = build(rows)
+    assert report.orphans == [99]
+    assert len(report.open) == 1  # rooted but never terminated
+
+
+def _hist_count(name: str) -> int:
+    h = METRICS.histogram(name)
+    return h.count() if h is not None else 0
+
+
+# --------------------------------------------------------------------------
+# 4. Tier-1 e2e: traced loopback fleet -> python -m tools.trace
+# --------------------------------------------------------------------------
+
+
+def test_traced_loopback_fleet_reconstructs_complete_timelines(
+    tmp_path, capsys
+):
+    """The ISSUE 6 acceptance loop: a real loopback fleet served with
+    --trace semantics, then ``python -m tools.trace --json --strict``
+    rebuilds every request's gateway→scheduler→miner→result timeline —
+    complete, no orphan spans, non-zero stage durations."""
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.apps import client as client_mod
+    from bitcoin_miner_tpu.apps import miner as miner_mod
+    from bitcoin_miner_tpu.apps import server as server_mod
+
+    trace_file = tmp_path / "fleet.trace.jsonl"
+    params = lsp.Params(epoch_limit=5, epoch_millis=200, window_size=5)
+    server = lsp.Server(0, params)
+    engine = Gateway(
+        Scheduler(min_chunk=500),
+        cache=ResultCache(),
+        spans=SpanStore(),
+        rate=None,
+    )
+    trace.TRACE.enable(path=str(trace_file))
+    try:
+        threading.Thread(
+            target=server_mod.serve,
+            args=(server, engine),
+            kwargs={"tick_interval": 0.05},
+            daemon=True,
+        ).start()
+        search = miner_mod.make_search("cpu")
+        for _ in range(2):
+            mc = lsp.Client("127.0.0.1", server.port, params)
+            threading.Thread(
+                target=miner_mod.run_miner, args=(mc, search), daemon=True
+            ).start()
+
+        jobs = [("tr1", 0, 2000), ("tr1", 0, 2000), ("tr2", 0, 1500)]
+        results = {}
+
+        def run_one(i, sig):
+            data, lo, hi = sig
+            c = lsp.Client("127.0.0.1", server.port, params)
+            try:
+                results[i] = client_mod.request_once(c, data, hi, lower=lo)
+            finally:
+                c.close()
+
+        threads = [
+            threading.Thread(target=run_one, args=(i, s), daemon=True)
+            for i, s in enumerate(jobs)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        # A repeat after completion: the cache answers it (still traced).
+        run_one(len(jobs), jobs[0])
+    finally:
+        server.close()
+        time.sleep(0.2)  # let the serve thread run its final flush
+        trace.TRACE.disable()
+
+    for i, sig in enumerate(jobs + [jobs[0]]):
+        want = min_hash_range(sig[0], sig[1], sig[2])
+        assert results[i] == want, (i, sig, results.get(i), want)
+
+    rc = trace_main([str(trace_file), "--json", "--strict"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out
+    assert out["orphans"] == [] and out["open"] == []
+    assert out["requests"] == 4
+    assert out["complete"] == 4
+    kinds = out["kinds"]
+    assert kinds.get("swept", 0) >= 2  # the two distinct signatures
+    assert kinds.get("coalesced", 0) + kinds.get("cache_hit", 0) >= 2
+    swept = [t for t in out["trees"] if t["kind"] == "swept"]
+    for t in swept:
+        assert t["total_s"] > 0.0
+        assert t["stages_s"].get("sweep", 0.0) > 0.0
+        assert t["chunks"] >= 1
+    # The stage breakdown has real mass: the fleet's time went somewhere.
+    assert sum(out["stage_totals_s"].values()) > 0.0
+    # The human report renders without crashing too.
+    assert trace_main([str(trace_file)]) == 0
+    assert "stage breakdown" in capsys.readouterr().out
+
+
+def test_chaos_drill_trace_reconstructs_with_no_orphans(tmp_path):
+    """A seeded chaos drill with a trace file is a deterministic
+    diagnosis: the drill stays oracle-exact AND its trace reconstructs
+    every request tree closed (answered or explicitly orphaned), with
+    the fleet's self-healing events alongside."""
+    from bitcoin_miner_tpu.apps.drill import run_drill
+
+    trace_file = tmp_path / "drill.trace.jsonl"
+    # kill_miner_at: miner-0 dies mid-sweep, so the trace must show the
+    # job's id surviving dead-miner reassignment (dispatches to the
+    # replacement miner carry the same trace).
+    report = run_drill(
+        "burst-loss", seed=11, data="tracechaos", max_nonce=2000,
+        n_miners=2, kill_miner_at=0.3, timeout=90.0,
+        trace_path=str(trace_file),
+    )
+    assert report.ok, report.as_dict()
+    rows = load(str(trace_file))
+    assert rows, "drill produced no trace records"
+    rep = build(rows)
+    assert rep.orphans == []
+    assert len(rep.complete) >= 1
+    # Every tree is closed: answered, or closed by the orphan stash when
+    # a retry superseded it mid-chaos.
+    assert not rep.open, [t.trace for t in rep.open]
+    swept = [t for t in rep.trees.values() if t.kind == "swept"]
+    assert swept and all(t.total_s > 0.0 for t in swept)

@@ -15,6 +15,9 @@ Five passes, one exit code:
   tools/analyze/tracecheck.py
 - ``sanitize`` — the runtime race sanitizer's machinery self-test (the
   BMT_SANITIZE=1 leg lives in the test suites).  tools/analyze/sanitcheck.py
+- ``metrics`` — every counter/histogram/gauge name emitted anywhere must
+  appear in the documented registry block in utils/metrics.py, and vice
+  versa (documented-but-never-emitted fails).  tools/analyze/metriccheck.py
 
 Grandfathered findings live in tools/analyze/ratchet.json and may only
 shrink.  See README "Static analysis & sanitizers".
@@ -23,7 +26,7 @@ shrink.  See README "Static analysis & sanitizers".
 from __future__ import annotations
 
 from .common import Finding, apply_ratchet, load_ratchet, save_ratchet  # noqa: F401
-from . import contracts, lockcheck, sanitcheck, tracecheck, wfqcheck  # noqa: F401
+from . import contracts, lockcheck, metriccheck, sanitcheck, tracecheck, wfqcheck  # noqa: F401
 
 PASSES = {
     "lock": lockcheck.run,
@@ -31,4 +34,5 @@ PASSES = {
     "contracts": contracts.run,
     "trace": tracecheck.run,
     "sanitize": sanitcheck.run,
+    "metrics": metriccheck.run,
 }

@@ -38,7 +38,9 @@ EXTERNAL_CLASSES = (
 #: must not silently rot away in a refactor.
 INTERNAL_CLASSES = (
     ("bitcoin_miner_tpu/utils/metrics.py", "Metrics"),
+    ("bitcoin_miner_tpu/utils/metrics.py", "Histogram"),
     ("bitcoin_miner_tpu/utils/metrics.py", "RateMeter"),
+    ("bitcoin_miner_tpu/utils/trace.py", "Tracer"),
     ("bitcoin_miner_tpu/lspnet/chaos.py", "NetSim"),
 )
 

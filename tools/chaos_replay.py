@@ -40,6 +40,9 @@ def main(argv=None) -> int:
     parser.add_argument("--timeout", type=float, default=120.0)
     parser.add_argument("--list", action="store_true",
                         help="list scenario names and exit")
+    parser.add_argument("--trace", metavar="FILE", default=None,
+                        help="write the drill's structured event log here "
+                             "(JSONL; analyze with python -m tools.trace)")
     parser.add_argument("-v", "--verbose", action="store_true",
                         help="enable lspnet debug drop logging")
     args = parser.parse_args(argv)
@@ -64,6 +67,7 @@ def main(argv=None) -> int:
             kill_miner_at=args.kill_at,
             epoch_millis=args.epoch_millis,
             timeout=args.timeout,
+            trace_path=args.trace,
         )
     except ValueError as e:  # e.g. a typoed --scenario name
         print(f"chaos_replay: {e}", file=sys.stderr)

@@ -57,8 +57,14 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
 
 from bitcoin_miner_tpu.bitcoin.hash import hash_nonce  # noqa: E402
 from bitcoin_miner_tpu.bitcoin.message import Message, MsgType  # noqa: E402
+from bitcoin_miner_tpu.utils.metrics import Histogram  # noqa: E402
 
 REPO = Path(__file__).resolve().parents[1]
+
+#: Request→result latency of every job this bench ran (warm-ups, class
+#: warms, timed, drills) — p50/p95/p99 land in the BENCH JSON line so the
+#: perf trajectory has a latency axis next to nonces/s (ISSUE 6).
+LATENCY = Histogram()
 
 
 def log(*a) -> None:
@@ -192,6 +198,7 @@ def run_job(
     if isinstance(out, BaseException):
         raise out
     dt = time.monotonic() - t0
+    LATENCY.observe(dt)
     msg = Message.unmarshal(out)
     assert msg is not None and msg.type == MsgType.RESULT, out
     # Full-argmin verification of a 2e10 job is beyond any CPU oracle; the
@@ -269,6 +276,13 @@ def main() -> int:
         default=None,
         help="path for the miner's chunk-timing stderr log (default: temp)",
     )
+    ap.add_argument(
+        "--trace",
+        metavar="FILE",
+        default=None,
+        help="arm the server's structured event log (BMT_TRACE) and write "
+        "it here; analyze with python -m tools.trace",
+    )
     args = ap.parse_args()
 
     port = args.port or 3000 + (os.getpid() * 7919) % 50000
@@ -281,6 +295,12 @@ def main() -> int:
     cpu_miners: list = []
     try:
         server_env = {**os.environ, "PYTHONPATH": str(REPO)}
+        if args.trace:
+            # The server process owns the gateway/scheduler events; its
+            # ticker drains them to the file (apps/server.main reads
+            # BMT_TRACE, the env spelling of --trace=FILE).
+            server_env["BMT_TRACE"] = os.path.abspath(args.trace)
+            log(f"trace: server event log -> {args.trace}")
         if args.chaos:
             from bitcoin_miner_tpu.lspnet.chaos import standard_scenarios
 
@@ -440,6 +460,11 @@ def main() -> int:
                     "wall_s": round(timed["wall_s"], 3),
                     "warmup_nonces": args.warmup,
                     "warmup_wall_s": round(warm["wall_s"], 3),
+                    "latency_s": {
+                        k: round(v, 4)
+                        for k, v in LATENCY.snapshot().items()
+                        if k in ("p50", "p95", "p99")
+                    } | {"count": LATENCY.count()},
                     # Involuntary (wedge/death) recoveries only; the
                     # drill's deliberate kill is counted in kill_drill.
                     "miner_restarts": keeper.restarts

@@ -121,7 +121,7 @@ def run_leg(
     from bitcoin_miner_tpu.apps import server as server_mod
     from bitcoin_miner_tpu.apps.scheduler import Scheduler
     from bitcoin_miner_tpu.gateway import Gateway, ResultCache, SpanStore
-    from bitcoin_miner_tpu.utils.metrics import METRICS
+    from bitcoin_miner_tpu.utils.metrics import METRICS, Histogram
 
     params = lsp.Params(epoch_limit=5, epoch_millis=200, window_size=5)
     server = lsp.Server(0, params)
@@ -156,6 +156,10 @@ def run_leg(
     errors: list = []
     cursor = [0]
     cursor_lock = threading.Lock()
+    # Client-observed request→result latency (ISSUE 6): one mergeable
+    # log-bucket histogram per leg, p50/p95/p99 into the BENCH JSON line
+    # so the perf trajectory has a latency axis next to jobs/s.
+    latency = Histogram()
 
     def worker(idx: int) -> None:
         while True:
@@ -166,10 +170,12 @@ def run_leg(
                 cursor[0] += 1
             data, lo, hi = jobs[job_i]
             c = lsp.Client("127.0.0.1", server.port, params)
+            t_req = time.monotonic()
             try:
                 got = client_mod.request_once(c, data, hi, lower=lo)
             finally:
                 c.close()
+            latency.observe(time.monotonic() - t_req)
             want = oracle[(data, lo, hi)]
             if got != want:
                 errors.append(
@@ -227,12 +233,19 @@ def run_leg(
             f"{'gateway' if gateway_on else 'baseline'} leg failed: "
             + "; ".join(errors[:5])
         )
+    lat = latency.snapshot()
     return {
         "wall_s": wall,
         "jobs_per_sec": len(jobs) / wall if wall > 0 else 0.0,
         "counters": deltas,
         "repeat_zero_chunks": repeat_zero_chunks,
         "subrange_zero_chunks": subrange_zero_chunks,
+        "latency_s": {
+            "p50": round(lat["p50"], 6),
+            "p95": round(lat["p95"], 6),
+            "p99": round(lat["p99"], 6),
+            "count": int(lat["count"]),
+        },
     }
 
 
@@ -310,6 +323,13 @@ def main(argv=None) -> int:
     ap.add_argument("--overlap", action="store_true",
                     help="interval-store bench: nested/overlapping ranges, "
                          "SpanStore leg vs exact-match-cache leg")
+    ap.add_argument("--trace", metavar="FILE", default=None,
+                    help="arm the structured event log during the gateway "
+                         "leg and write it here (python -m tools.trace)")
+    ap.add_argument("--trace-overhead", action="store_true",
+                    help="run the gateway leg a second time with tracing "
+                         "armed and report the jobs/s overhead (the ISSUE 6 "
+                         "<5%% acceptance number)")
     ap.add_argument("--fast", action="store_true",
                     help="tier-1 preset: small jobs, done in well under 30 s")
     args = ap.parse_args(argv)
@@ -333,9 +353,35 @@ def main(argv=None) -> int:
     if args.overlap:
         return _overlap_main(jobs, distinct, args, oracle)
 
-    gw = run_leg(True, jobs, args, oracle)
+    import tempfile
+    from contextlib import ExitStack
+
+    from bitcoin_miner_tpu.utils.trace import tracing
+
+    traced = plain = None
+    with ExitStack() as stack:
+        if args.trace:
+            stack.enter_context(tracing(args.trace))
+        elif args.trace_overhead:
+            # No sink requested: trace into a throwaway temp file so the
+            # flush path is part of the measured cost too.
+            tf = stack.enter_context(
+                tempfile.NamedTemporaryFile(suffix=".trace.jsonl")
+            )
+            stack.enter_context(tracing(tf.name))
+        gw = run_leg(True, jobs, args, oracle)
     log(f"gateway leg: {gw['jobs_per_sec']:.2f} jobs/s over "
         f"{gw['wall_s']:.2f}s; counters {gw['counters']}")
+    if args.trace_overhead:
+        # The ISSUE 6 acceptance number: the SAME workload traced vs
+        # untraced, the TRACED leg always first whatever flag spelling
+        # armed it — any residual leg-order warmup bias then inflates
+        # the reported overhead, never masks it (conservative for a
+        # "<5%" acceptance claim).
+        traced = gw
+        plain = run_leg(True, jobs, args, oracle)
+        log(f"untraced gateway leg: {plain['jobs_per_sec']:.2f} jobs/s "
+            f"over {plain['wall_s']:.2f}s")
     base = None
     if not args.no_baseline:
         base = run_leg(False, jobs, args, oracle)
@@ -356,10 +402,23 @@ def main(argv=None) -> int:
         "fast": bool(args.fast),
         "wall_s": round(gw["wall_s"], 3),
         "repeat_zero_chunks": gw["repeat_zero_chunks"],
+        "latency_s": gw["latency_s"],
         "gateway_counters": {
             k: v for k, v in gw["counters"].items() if k.startswith("gateway.")
         },
         "swept_nonces": gw["counters"].get("sched.nonces_swept", 0),
+        **(
+            {
+                "traced_jobs_per_sec": round(traced["jobs_per_sec"], 3),
+                "trace_overhead": round(
+                    1.0 - traced["jobs_per_sec"] / plain["jobs_per_sec"], 4
+                )
+                if plain["jobs_per_sec"] > 0
+                else None,
+            }
+            if traced is not None and plain is not None
+            else {}
+        ),
         **(
             {
                 "baseline_jobs_per_sec": round(base["jobs_per_sec"], 3),
@@ -409,6 +468,8 @@ def _overlap_main(jobs, distinct, args, oracle) -> int:
         "wall_s": round(spans["wall_s"], 3),
         "repeat_zero_chunks": spans["repeat_zero_chunks"],
         "subrange_zero_chunks": spans["subrange_zero_chunks"],
+        "latency_s": spans["latency_s"],
+        "exact_latency_s": exact["latency_s"],
         "span_counters": {
             k: v for k, v in spans["counters"].items()
             if k.startswith("gateway.")

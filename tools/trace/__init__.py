@@ -1,0 +1,290 @@
+"""Trace-file analysis: rebuild per-request timelines from the event log.
+
+``utils/trace.py`` writes JSONL rows ``{"t", "trace", "span", "event",
+"attrs"}``; this package turns a file of them back into **one tree per
+request** (trace ids are minted at the gateway / scheduler entry point
+and threaded through every layer) plus a stage/critical-path breakdown:
+where did each request's wall time go — admission queue, scheduling,
+kernel sweep, delivery?  Rows with a null trace id are fleet
+infrastructure events (miner tier downgrades, reconnects, LSP
+retransmits) and are reported alongside, so a seeded chaos drill's trace
+is a deterministic diagnosis: replay the drill, read the trace, see WHY
+a tier was abandoned while request N stalled.
+
+CLI: ``python -m tools.trace FILE [--json] [--strict] [--requests N]``
+(``--strict`` exits non-zero on orphan spans or unterminated trees —
+the tier-1 loopback-fleet test runs it that way).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+#: (span, event) pairs that BIRTH a request tree.  A trace id whose
+#: events include none of these is an orphan span — something emitted on
+#: an id that was never minted at an entry point.
+ROOT_EVENTS = {("gw", "request"), ("sched", "job_start")}
+
+#: (span, event) pairs that CLOSE a tree.  Every request must reach one
+#: — answered (result/job_done), refused (shed), or abandoned with its
+#: progress stashed (job_orphaned / waiter_lost); a rooted tree with no
+#: terminal is still open (in flight at snapshot time, or lost work).
+TERMINAL_EVENTS = {
+    ("gw", "result"),
+    ("gw", "shed"),
+    ("gw", "waiter_lost"),
+    ("sched", "job_done"),
+    ("sched", "job_orphaned"),
+}
+
+#: Stage names in timeline order (the breakdown report's row order).
+STAGES = ("admission", "scheduling", "sweep", "deliver")
+
+
+def load(path: str) -> List[dict]:
+    """Parse one JSONL trace file; malformed lines are skipped (a torn
+    final line from a killed server must not hide the rest)."""
+    rows: List[dict] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(row, dict) and "t" in row and "event" in row:
+                rows.append(row)
+    return rows
+
+
+@dataclass
+class RequestTree:
+    """Every event carrying one trace id, in time order."""
+
+    trace: int
+    events: List[dict] = field(default_factory=list)
+
+    def _find(self, span: str, event: str) -> Optional[dict]:
+        for e in self.events:
+            if e.get("span") == span and e.get("event") == event:
+                return e
+        return None
+
+    def _all(self, span: str, event: str) -> List[dict]:
+        return [
+            e
+            for e in self.events
+            if e.get("span") == span and e.get("event") == event
+        ]
+
+    @property
+    def root(self) -> Optional[dict]:
+        for e in self.events:
+            if (e.get("span"), e.get("event")) in ROOT_EVENTS:
+                return e
+        return None
+
+    @property
+    def terminal(self) -> Optional[dict]:
+        for e in reversed(self.events):
+            if (e.get("span"), e.get("event")) in TERMINAL_EVENTS:
+                return e
+        return None
+
+    @property
+    def complete(self) -> bool:
+        return self.root is not None and self.terminal is not None
+
+    @property
+    def kind(self) -> str:
+        """How the request was served: cache_hit / span_hit / coalesced /
+        shed / swept / lost / open."""
+        if self._find("gw", "cache_hit") is not None:
+            return "cache_hit"
+        if self._find("gw", "span_hit") is not None:
+            return "span_hit"
+        if self._find("gw", "coalesce") is not None:
+            return "coalesced"
+        if self._find("gw", "shed") is not None:
+            return "shed"
+        if self.terminal is None:
+            return "open"
+        if (
+            self._find("sched", "job_done") is not None
+            or self._find("gw", "result") is not None
+        ):
+            return "swept"
+        return "lost"  # orphaned / waiter death closed it
+
+    def signature(self) -> Optional[Tuple[str, int, int]]:
+        root = self.root
+        if root is None:
+            return None
+        a = root.get("attrs", {})
+        if all(k in a for k in ("data", "lower", "upper")):
+            return (a["data"], a["lower"], a["upper"])
+        if all(k in a for k in ("lower", "upper")):
+            return ("", a["lower"], a["upper"])
+        return None
+
+    @property
+    def total_s(self) -> float:
+        root, term = self.root, self.terminal
+        if root is None or term is None:
+            return 0.0
+        return max(0.0, term["t"] - root["t"])
+
+    def chunks(self) -> List[dict]:
+        """Per-chunk timing rows: each dispatch consumes the next
+        chunk_result with the same (miner, lo) in time order — a
+        straggler-requeued chunk re-dispatched to the same miner gets at
+        most one result attributed, never two copies of the same one.
+        Unmatched dispatches (in flight / reassigned) carry elapsed None.
+        """
+        results: Dict[Tuple, List[dict]] = {}
+        for e in self._all("sched", "chunk_result"):
+            a = e.get("attrs", {})
+            results.setdefault((a.get("miner"), a.get("lo")), []).append(e)
+        out: List[dict] = []
+        for d in self._all("sched", "dispatch"):
+            a = d.get("attrs", {})
+            pending = results.get((a.get("miner"), a.get("lo")))
+            r = pending.pop(0) if pending else None
+            out.append(
+                {
+                    "miner": a.get("miner"),
+                    "lo": a.get("lo"),
+                    "hi": a.get("hi"),
+                    "t_dispatch": d["t"],
+                    "elapsed": (
+                        r.get("attrs", {}).get("elapsed")
+                        if r is not None
+                        else None
+                    ),
+                }
+            )
+        return out
+
+    def stages(self) -> Dict[str, float]:
+        """Wall-time breakdown of a swept request (empty for zero-work
+        answers): admission (queue wait), scheduling (submit → first
+        chunk on a miner), sweep (first dispatch → job done), deliver
+        (job done → result on the wire)."""
+        root = self.root
+        if root is None:
+            return {}
+        queued = self._find("gw", "queued")
+        admitted = self._find("gw", "admitted")
+        submit = self._find("gw", "submit") or self._find("sched", "job_start")
+        dispatches = self._all("sched", "dispatch")
+        done = self._find("sched", "job_done")
+        result = self._find("gw", "result")
+        out: Dict[str, float] = {}
+        if queued is not None and admitted is not None:
+            out["admission"] = max(0.0, admitted["t"] - queued["t"])
+        if submit is not None and dispatches:
+            out["scheduling"] = max(0.0, dispatches[0]["t"] - submit["t"])
+        if dispatches and done is not None:
+            out["sweep"] = max(0.0, done["t"] - dispatches[0]["t"])
+        if done is not None and result is not None:
+            out["deliver"] = max(0.0, result["t"] - done["t"])
+        return out
+
+    def critical_stage(self) -> Optional[str]:
+        """The stage that dominated this request's wall time."""
+        stages = self.stages()
+        if not stages:
+            return None
+        return max(stages.items(), key=lambda kv: kv[1])[0]
+
+
+@dataclass
+class TraceReport:
+    trees: Dict[int, RequestTree]
+    orphans: List[int]  # trace ids with events but no root
+    fleet: List[dict]  # null-trace infrastructure events
+
+    @property
+    def complete(self) -> List[RequestTree]:
+        return [t for t in self.trees.values() if t.complete]
+
+    @property
+    def open(self) -> List[RequestTree]:
+        return [
+            t
+            for t in self.trees.values()
+            if t.root is not None and t.terminal is None
+        ]
+
+    def stage_totals(self) -> Dict[str, float]:
+        """Aggregate seconds per stage across every swept request — the
+        critical-path view: which stage is the fleet's time actually
+        going to?"""
+        totals = {s: 0.0 for s in STAGES}
+        for tree in self.trees.values():
+            for name, dt in tree.stages().items():
+                totals[name] = totals.get(name, 0.0) + dt
+        return totals
+
+    def fleet_summary(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.fleet:
+            key = f"{e.get('span')}.{e.get('event')}"
+            out[key] = out.get(key, 0) + 1
+        return out
+
+    def as_dict(self) -> dict:
+        kinds: Dict[str, int] = {}
+        for t in self.trees.values():
+            kinds[t.kind] = kinds.get(t.kind, 0) + 1
+        return {
+            "requests": len(self.trees),
+            "complete": len(self.complete),
+            "open": sorted(t.trace for t in self.open),
+            "orphans": sorted(self.orphans),
+            "kinds": kinds,
+            "stage_totals_s": {
+                k: round(v, 6) for k, v in self.stage_totals().items()
+            },
+            "fleet_events": self.fleet_summary(),
+            "trees": [
+                {
+                    "trace": t.trace,
+                    "kind": t.kind,
+                    "complete": t.complete,
+                    "signature": list(t.signature() or ()) or None,
+                    "total_s": round(t.total_s, 6),
+                    "stages_s": {
+                        k: round(v, 6) for k, v in t.stages().items()
+                    },
+                    "chunks": len(t.chunks()),
+                    "events": len(t.events),
+                }
+                for t in sorted(self.trees.values(), key=lambda t: t.trace)
+            ],
+        }
+
+
+def build(rows: List[dict]) -> TraceReport:
+    """Group rows into request trees + fleet events (time-sorted)."""
+    rows = sorted(rows, key=lambda r: r.get("t", 0.0))
+    trees: Dict[int, RequestTree] = {}
+    fleet: List[dict] = []
+    for row in rows:
+        tid = row.get("trace")
+        if tid is None:
+            fleet.append(row)
+            continue
+        tree = trees.get(tid)
+        if tree is None:
+            tree = trees[tid] = RequestTree(trace=tid)
+        tree.events.append(row)
+    orphans = [tid for tid, t in trees.items() if t.root is None]
+    for tid in orphans:
+        del trees[tid]
+    return TraceReport(trees=trees, orphans=orphans, fleet=fleet)

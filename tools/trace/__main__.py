@@ -1,0 +1,110 @@
+"""CLI for the trace reconstructor: ``python -m tools.trace FILE``.
+
+Reads one JSONL trace file (``--trace=FILE`` on the server, a drill's
+``trace_path``, or ``BMT_TRACE`` in a subprocess bench), rebuilds one
+tree per request, and prints the per-request timelines plus the
+stage/critical-path breakdown.  ``--json`` emits the machine view (the
+tier-1 loopback test asserts over it); ``--strict`` exits non-zero on
+orphan spans or unterminated trees.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+from . import STAGES, build, load
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.trace")
+    ap.add_argument("file", help="JSONL trace file (utils/trace.py format)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on orphan spans or unterminated trees")
+    ap.add_argument("--requests", type=int, default=20, metavar="N",
+                    help="show at most N per-request lines (default 20)")
+    args = ap.parse_args(argv)
+
+    try:
+        rows = load(args.file)
+    except OSError as e:
+        print(f"tools.trace: cannot read {args.file}: {e}", file=sys.stderr)
+        return 2
+    report = build(rows)
+    bad = bool(report.orphans or report.open)
+
+    if args.as_json:
+        print(json.dumps(report.as_dict()))
+        return 1 if (args.strict and bad) else 0
+
+    d = report.as_dict()
+    print(
+        f"trace: {len(rows)} records, {d['requests']} request(s) "
+        f"({d['complete']} complete, {len(d['open'])} open, "
+        f"{len(d['orphans'])} orphan span(s)), "
+        f"{sum(d['fleet_events'].values())} fleet event(s)"
+    )
+    if d["kinds"]:
+        kinds = ", ".join(f"{k}={v}" for k, v in sorted(d["kinds"].items()))
+        print(f"served: {kinds}")
+
+    totals = report.stage_totals()
+    grand = sum(totals.values())
+    if grand > 0:
+        print("stage breakdown (aggregate over swept requests):")
+        for name in STAGES:
+            dt = totals.get(name, 0.0)
+            print(f"  {name:<12} {dt:9.4f}s  {dt / grand:6.1%}")
+    crit: dict = {}
+    for tree in report.trees.values():
+        stage = tree.critical_stage()
+        if stage is not None:
+            crit[stage] = crit.get(stage, 0) + 1
+    if crit:
+        dominant = ", ".join(
+            f"{k}×{v}" for k, v in sorted(crit.items(), key=lambda kv: -kv[1])
+        )
+        print(f"critical stage per request: {dominant}")
+
+    shown = 0
+    for tree in sorted(report.trees.values(), key=lambda t: t.trace):
+        if shown >= args.requests:
+            remaining = len(report.trees) - shown
+            print(f"  ... {remaining} more (raise --requests)")
+            break
+        sig = tree.signature()
+        sig_s = f"{sig[0]!r}[{sig[1]},{sig[2]}]" if sig else "?"
+        stages = " ".join(
+            f"{k}={v:.4f}s" for k, v in tree.stages().items()
+        )
+        extra = f" {stages}" if stages else ""
+        chunks = len(tree.chunks())
+        chunk_s = f" chunks={chunks}" if chunks else ""
+        print(
+            f"  #{tree.trace} {tree.kind:<9} {sig_s} "
+            f"total={tree.total_s:.4f}s{chunk_s}{extra}"
+        )
+        shown += 1
+
+    if report.open:
+        print(f"open trees (no terminal event): "
+              f"{sorted(t.trace for t in report.open)}")
+    if report.orphans:
+        print(f"orphan spans (events on an unminted id): "
+              f"{sorted(report.orphans)}")
+    for key, n in sorted(report.fleet_summary().items()):
+        print(f"fleet: {key} ×{n}")
+    # The WHYs, verbatim, for the abandonment events a soak cares about.
+    for e in report.fleet:
+        if e.get("event") in ("tier_downgrade", "wedge_detected", "gave_up"):
+            print(f"fleet detail: t={e['t']:.3f} {e['span']}.{e['event']} "
+                  f"{e.get('attrs', {})}")
+    return 1 if (args.strict and bad) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
