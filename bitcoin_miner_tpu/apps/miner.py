@@ -660,6 +660,19 @@ def main(argv=None) -> int:
     # wedge timeout.
     parser.add_argument("--reconnect", type=int, default=5)
     parser.add_argument("--watchdog", type=float, default=None)
+    # Telemetry sidecar (ISSUE 7): ship periodic metric snapshots to the
+    # server's --telemetry-port over a SECOND LSP connection.  Entirely
+    # off the sweep path (a daemon timer thread with its own conn and
+    # backoff); BMT_TELEMETRY is the env spelling for subprocess benches.
+    parser.add_argument(
+        "--telemetry", metavar="HOSTPORT",
+        default=os.environ.get("BMT_TELEMETRY") or None,
+    )
+    parser.add_argument("--telemetry-interval", type=float, default=2.0)
+    parser.add_argument(
+        "--source", default=None,
+        help="telemetry source name (default miner-<pid>)",
+    )
     parser.add_argument("--multihost", action="store_true")
     parser.add_argument("--coordinator", default=None)
     parser.add_argument("--num-hosts", type=int, default=None)
@@ -722,6 +735,20 @@ def main(argv=None) -> int:
                 _inner.close()
 
         search = _LoggedSearch()
+    exporter = None
+    if args.telemetry:
+        from ..utils.telemetry import TelemetryExporter
+
+        thost, _, tport = args.telemetry.rpartition(":")
+        try:
+            exporter = TelemetryExporter(
+                thost or "127.0.0.1", int(tport),
+                args.source or f"miner-{os.getpid()}",
+                interval=args.telemetry_interval,
+            ).start()
+        except ValueError as e:
+            print("Invalid miner configuration:", e)
+            return 0
     host, _, port = args.hostport.rpartition(":")
     try:
         client = lsp.Client(host or "127.0.0.1", int(port))
@@ -740,6 +767,8 @@ def main(argv=None) -> int:
         else:
             run_miner(client, search)
     finally:
+        if exporter is not None:
+            exporter.stop()
         try:
             client.close()
         except lsp.LspError:

@@ -26,7 +26,7 @@ from .. import lsp
 from ..bitcoin.message import Message, MsgType
 from ..utils import sanitize
 from ..utils import trace as trace_mod
-from ..utils.metrics import METRICS, RateMeter
+from ..utils.metrics import METRICS, RateMeter, format_quantiles
 from ..utils.persist import load_json, save_json_atomic
 from .scheduler import Scheduler
 
@@ -45,6 +45,7 @@ def serve(
     tick_interval: float = 1.0,
     checkpoint_path: Optional[str] = None,
     health_interval: float = 10.0,
+    telemetry=None,
 ) -> None:
     """Run the scheduler loop over an already-listening LSP server until the
     server is closed.  Factored out of main() so tests drive it in-process.
@@ -53,6 +54,13 @@ def serve(
     seconds (straggler reclamation — ``server.read()`` blocks, so the scan
     can't live on the read loop) and, if ``checkpoint_path`` is set,
     persists the scheduler's resumable progress there.
+
+    ``telemetry`` is an optional already-started
+    :class:`~bitcoin_miner_tpu.utils.telemetry.TelemetryHub` (ISSUE 7):
+    the ticker drives its :meth:`tick` each beat — fleet-view merge, SLO
+    burn-rate evaluation, straggler detection, publish sinks — OFF the
+    event lock (the hub carries its own locks), so a full fleet-log disk
+    or a dead dashboard can never stall the serve loop.
     """
     log = log or logging.getLogger("bitcoin_miner_tpu.server")
     # Serializes scheduler access with the ticker (tracked under
@@ -97,6 +105,9 @@ def serve(
     # keeps using lifetime numbers — see utils/metrics.RateMeter).
     recent_nps = RateMeter(clock=clock, window=max(3 * health_interval, 10.0))
     swept_seen = [None]  # last sched.nonces_swept sample (None = first tick)
+    # Last fleet-plane state (merged view + SLO verdicts) for the health
+    # line.  Written and read on the ticker thread only.
+    fleet_state = [None]  # unguarded: ticker-thread only
 
     def health_line() -> str:  # guarded-by: lock (callers hold the event lock)
         counters = {
@@ -126,14 +137,26 @@ def serve(
         }
         line = f"health {sched.stats()} {counters} nps={recent_nps.rate():.3g}"
         # Latency distributions (ISSUE 6): request→result and chunk RTT
-        # p50/p95/p99 ride the line once samples exist, so "where does a
-        # request's time go" is visible in log.txt without a trace file.
+        # p50/p95/p99 ride the line, so "where does a request's time go"
+        # is visible in log.txt without a trace file.  format_quantiles
+        # renders a sample-less histogram as -/-/- — a 0 here would read
+        # as "instant", not "no data" (ISSUE 7 satellite).
         for label, name in (("req", "hist.request_s"), ("chunk", "hist.chunk_rtt_s")):
-            h = METRICS.histogram(name)
-            if h is not None and h.count():
-                s = h.snapshot()
-                line += (
-                    f" {label}_lat_s={s['p50']:.3g}/{s['p95']:.3g}/{s['p99']:.3g}"
+            line += f" {label}_lat_s={format_quantiles(METRICS.histogram(name))}"
+        # Fleet plane (ISSUE 7): live/total telemetry sources, flagged
+        # stragglers, and the SLO firing set, from the hub's last tick.
+        fs = fleet_state[0]
+        if fs is not None:
+            total = fs["sources"] + fs["stale_sources"]
+            line += f" fleet={fs['sources']}/{total}"
+            if fs.get("stragglers"):
+                names = ",".join(s["source"] for s in fs["stragglers"])
+                line += f" stragglers={names}"
+            slo_state = fs.get("slo")
+            if slo_state is not None:
+                alerts = slo_state["alerts"]
+                line += " slo=" + (
+                    "ALERT[" + ",".join(alerts) + "]" if alerts else "ok"
                 )
         return f"{line} extra {extra}" if extra else line
 
@@ -183,6 +206,16 @@ def serve(
                 METRICS.set_gauge("gauge.sched_vt_floor", vt)
                 if qvt is not None:
                     METRICS.set_gauge("gauge.gw_vt_floor", qvt)
+                # Fleet metrics plane (ISSUE 7): merge this process's
+                # registry into the fleet view, evaluate SLO burn rates,
+                # run the straggler detector, feed the publish sinks.
+                # Off the event lock — the hub owns its own locks — and
+                # failure-isolated like every other ticker artifact.
+                if telemetry is not None:
+                    try:
+                        fleet_state[0] = telemetry.tick()
+                    except Exception:
+                        log.exception("telemetry tick failed; will retry")
                 # Structured-event drain (--trace=FILE): append buffered
                 # records as JSONL, file I/O outside the event lock; a
                 # no-op when tracing is off or has no sink.  Guarded like
@@ -342,6 +375,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     # to the file by serve()'s ticker; BMT_TRACE is the env spelling so
     # subprocess benches (tools/fleet_bench.py) can arm it too.
     trace_path = os.environ.get("BMT_TRACE") or None
+    # Fleet metrics plane (ISSUE 7), env spellings for subprocess benches:
+    # --telemetry-port=P listens for miner snapshot sidecars there;
+    # --fleet-log=FILE appends the merged view as JSONL (tools.dash reads
+    # it); --prom=FILE maintains a Prometheus text exposition;
+    # --slo[=CONF] arms burn-rate alerting (utils/slo.parse_slo_config).
+    telemetry_port = os.environ.get("BMT_TELEMETRY_PORT") or None
+    fleet_log = os.environ.get("BMT_FLEET_LOG") or None
+    prom_path = os.environ.get("BMT_PROM") or None
+    slo_conf = os.environ.get("BMT_SLO") or None
     rate: Optional[float] = 5.0
     burst = 10.0
     max_queued = 256
@@ -351,6 +393,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             checkpoint_path = a.split("=", 1)[1]
         elif a.startswith("--trace="):
             trace_path = a.split("=", 1)[1]
+        elif a.startswith("--telemetry-port="):
+            telemetry_port = a.split("=", 1)[1]
+        elif a.startswith("--fleet-log="):
+            fleet_log = a.split("=", 1)[1]
+        elif a.startswith("--prom="):
+            prom_path = a.split("=", 1)[1]
+        elif a == "--slo":
+            slo_conf = "1"
+        elif a.startswith("--slo="):
+            slo_conf = a.split("=", 1)[1]
         elif a == "--gateway":
             gateway_on = True
         elif a.startswith("--cache="):
@@ -379,6 +431,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     try:
         port = int(pos[0])
+        tport = int(telemetry_port) if telemetry_port is not None else None
     except ValueError as e:
         print("Port must be a number:", e)
         return 0
@@ -419,9 +472,42 @@ def main(argv: Optional[List[str]] = None) -> int:
             burst=burst,
             max_queued=max_queued,
         )
+    # Any fleet-plane knob arms the hub: the sidecar listener needs a
+    # port, but the SLO engine and the publish sinks are useful even on a
+    # single-process server (the local registry is its own source).
+    hub = None
+    if tport is not None or fleet_log or prom_path or slo_conf:
+        from ..utils.slo import SloEngine, parse_slo_config
+        from ..utils.telemetry import TelemetryHub
+
+        engine = None
+        if slo_conf:
+            try:
+                engine = SloEngine(parse_slo_config(slo_conf))
+            except ValueError as e:
+                print(str(e))
+                server.close()
+                return 0
+        try:
+            hub = TelemetryHub(
+                tport or 0,
+                slo=engine,
+                fleet_log=fleet_log,
+                prom_path=prom_path,
+            ).start()
+        except OSError as e:
+            # Same friendly contract as a busy serving port — no traceback.
+            print(str(e))
+            server.close()
+            return 0
     try:
-        serve(server, scheduler=sched, checkpoint_path=checkpoint_path)
+        serve(
+            server, scheduler=sched, checkpoint_path=checkpoint_path,
+            telemetry=hub,
+        )
     finally:
+        if hub is not None:
+            hub.close()
         server.close()
     return 0
 

@@ -118,6 +118,20 @@ class Histogram:
             "p99": self.quantile(0.99),
         }
 
+    def count_above(self, threshold: float) -> int:
+        """Samples definitively >= ``threshold``: the cumulative count of
+        every bucket whose LOWER edge clears it (within-one-bucket slack,
+        like :meth:`quantile`).  The SLO engine's bad-event source — a
+        latency objective "p95 <= T" is exactly "no more than 5% of
+        samples above T", which this answers from the mergeable buckets."""
+        if threshold <= 0.0:
+            return self.count()
+        # First bucket whose lower edge 2**(i/4) clears the threshold
+        # (epsilon guards the exact-edge case against float drift).
+        first = math.ceil(math.log2(threshold) / _GROWTH_LOG2 - 1e-9)
+        with self._lock:
+            return sum(c for i, c in self._buckets.items() if i >= first)
+
     def buckets(self) -> Dict[int, int]:
         """Bucket-index -> count (the merge/property-test surface); the
         zero bucket is exposed separately via :meth:`zero_count`."""
@@ -127,6 +141,45 @@ class Histogram:
     def zero_count(self) -> int:
         with self._lock:
             return self._zero
+
+    # ------------------------------------------------- telemetry (ISSUE 7)
+
+    def state(self) -> Dict:
+        """The JSON-able mergeable state the telemetry sidecar ships:
+        bucket counts keyed by stringified index (JSON object keys are
+        strings), the zero bucket, count and sum.  ``from_state`` on any
+        process rebuilds an equivalent histogram — the fleet view merges
+        these without ever seeing raw samples."""
+        with self._lock:
+            return {
+                "buckets": {str(i): c for i, c in self._buckets.items()},
+                "zero": self._zero,
+                "count": self._count,
+                "sum": self._sum,
+            }
+
+    @classmethod
+    def from_state(cls, state) -> "Histogram":
+        """Rebuild a histogram from :meth:`state` output.  Telemetry is
+        best-effort: torn or garbage state decodes to an EMPTY histogram
+        instead of raising mid-merge."""
+        h = cls()
+        try:
+            buckets = {
+                int(i): int(c)
+                for i, c in dict(state.get("buckets", {})).items()
+            }
+            zero = int(state.get("zero", 0))
+            count = int(state.get("count", 0))
+            total = float(state.get("sum", 0.0))
+        except (TypeError, ValueError, AttributeError):
+            return h
+        with h._lock:
+            h._buckets.update(buckets)
+            h._zero = zero
+            h._count = count
+            h._sum = total
+        return h
 
 
 class Metrics:
@@ -196,6 +249,23 @@ class Metrics:
             out[name] = h.snapshot()
         return out
 
+    def export_state(self) -> Dict:
+        """The telemetry-sidecar snapshot (ISSUE 7): counters, gauges and
+        every histogram's mergeable :meth:`Histogram.state`, all
+        JSON-able.  ``utils/telemetry.py`` ships this over the sidecar
+        channel; ``utils/fleetview.py`` merges it per source.  Cost is
+        O(#metrics) under short per-object locks — safe from a timer
+        thread, never from a hot loop."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._hists)
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "hists": {name: h.state() for name, h in hists.items()},
+        }
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
@@ -203,11 +273,29 @@ class Metrics:
             self._gauges.clear()
 
 
+def format_quantiles(h) -> str:
+    """Render p50/p95/p99 for a health line or dashboard cell.
+
+    Accepts a :class:`Histogram`, a :meth:`Histogram.snapshot` dict, or
+    None.  An empty (or absent) histogram renders ``-/-/-``: its
+    ``snapshot()`` quantiles are numerically 0, and printing those reads
+    as "instant" when the truth is "no data" (ISSUE 7 satellite — every
+    quantile render site shares this helper so the fix cannot drift)."""
+    if h is None:
+        return "-/-/-"
+    s = h.snapshot() if isinstance(h, Histogram) else h
+    if not s or not s.get("count"):
+        return "-/-/-"
+    return f"{s['p50']:.3g}/{s['p95']:.3g}/{s['p99']:.3g}"
+
+
 #: The process-wide registry.  EVERY name used anywhere must be listed
 #: here and vice versa — the ``metrics`` analyzer pass
 #: (tools/analyze/metriccheck.py) fails the build on drift in either
 #: direction.  Kinds by prefix: ``hist.*`` are histograms (observe),
-#: ``gauge.*`` are gauges (set_gauge), everything else is a counter (inc).
+#: ``gauge.*`` AND ``fleet.*`` are gauges (set_gauge — the merged
+#: fleet-view levels published by utils/telemetry.py), everything else is
+#: a counter (inc).
 #:
 #:   lsp.retransmits       data messages resent on epoch ticks
 #:   lsp.delivered         in-order payloads handed to the application
@@ -244,6 +332,12 @@ class Metrics:
 #:   chaos.duplicated          packets the simulator emitted twice
 #:   chaos.reordered           packets given the reorder extra delay
 #:   chaos.delayed             packets delivered late (delay/jitter/reorder)
+#:   telemetry.exports         metric snapshots shipped over the sidecar channel
+#:   telemetry.export_errors   snapshot sends/connects that failed (channel down)
+#:   telemetry.snapshots_merged  snapshots folded into the server's fleet view
+#:   telemetry.decode_errors   telemetry payloads that failed to decode
+#:   slo.alerts_fired          SLO burn-rate alerts that transitioned to firing
+#:   slo.alerts_resolved       firing SLO alerts that cleared
 #:   hist.request_s            request→result latency at the gateway (s)
 #:   hist.chunk_rtt_s          chunk dispatch→Result round-trip (s)
 #:   hist.admission_wait_s     admission-queue wait before dispatch (s)
@@ -255,6 +349,9 @@ class Metrics:
 #:   gauge.admission_backlog   requests parked in the admission queue
 #:   gauge.sched_vt_floor      scheduler tenant WFQ leading virtual time
 #:   gauge.gw_vt_floor         gateway admission WFQ leading virtual time
+#:   fleet.sources             fresh telemetry sources in the fleet view
+#:   fleet.sources_stale       sources aged past the staleness window
+#:   fleet.stragglers          sources flagged by the straggler detector
 METRICS = Metrics()
 
 

@@ -7,6 +7,9 @@
   block (``metric-undocumented``);
 - ``hist.fixture_latency`` is documented as a histogram but emitted via
   ``inc`` (``metric-kind-mismatch``);
+- ``fleet.fixture_sources`` is a fleet-view gauge (``fleet.*`` names are
+  gauge-kind, ISSUE 7) but emitted via ``inc``
+  (``metric-kind-mismatch``);
 - the computed-name ``inc`` cannot be registry-checked at all
   (``metric-dynamic-name``).
 """
@@ -19,15 +22,20 @@ class Metrics:  # stand-in so the fixture never imports the real package
     def observe(self, name, value):
         pass
 
+    def set_gauge(self, name, value):
+        pass
+
 
 #: The fixture's registry block (same format as utils/metrics.py: the
 #: contiguous ``#:`` lines directly above the METRICS assignment).
 #:   fixture.documented_only   documented here, emitted nowhere
 #:   hist.fixture_latency      a histogram name (observe-only kind)
+#:   fleet.fixture_sources     a fleet-view gauge (set_gauge-only kind)
 METRICS = Metrics()
 
 
 def provoke_metric_drift(suffix: str) -> None:
     METRICS.inc("fixture.never_documented")  # undocumented counter
     METRICS.inc("hist.fixture_latency")  # wrong emitter for a hist.* name
+    METRICS.inc("fleet.fixture_sources")  # wrong emitter for a fleet.* gauge
     METRICS.inc("fixture." + suffix)  # dynamic name: unverifiable

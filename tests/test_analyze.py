@@ -185,6 +185,10 @@ def test_metrics_rules_fire_on_fixture():
     assert "fixture.never_documented" in symbols
     assert "fixture.documented_only" in symbols
     assert "hist.fixture_latency" in symbols
+    # fleet.* names are gauge-kind (ISSUE 7): inc() on one must fire.
+    assert ("metric-kind-mismatch", "fleet.fixture_sources") in {
+        (f.rule, f.symbol) for f in findings
+    }
 
 
 def test_metrics_pass_honors_metric_ok_declaration(tmp_path):

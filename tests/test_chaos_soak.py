@@ -495,6 +495,95 @@ def test_fast_scenario_green_under_race_sanitizer():
         sanitize.reset_order_graph()
 
 
+@pytest.mark.fleet
+def test_telemetry_partition_ages_source_out_while_fleet_keeps_sweeping():
+    """ISSUE 7: burst loss + a directional partition of the TELEMETRY
+    sidecar must never touch the serving plane.  The partitioned source
+    ages out of the fleet view as stale, the miner keeps sweeping to a
+    bit-exact Result through the ambient loss, and the serve ticker
+    (which drives the hub) never blocks — then the heal brings the
+    source back fresh via exporter reconnect."""
+    from bitcoin_miner_tpu.utils.fleetview import FleetView
+    from bitcoin_miner_tpu.utils.telemetry import (
+        TelemetryExporter,
+        TelemetryHub,
+    )
+
+    CHAOS.seed(21)
+    hub = TelemetryHub(
+        0, params=PARAMS, publish_interval=0.1,
+        fleet=FleetView(staleness_s=1.5),
+    ).start()
+    server = lsp.Server(0, PARAMS, label="server")
+    threading.Thread(
+        target=server_mod.serve,
+        args=(server, Scheduler(min_chunk=500)),
+        kwargs={"tick_interval": 0.1, "telemetry": hub},
+        daemon=True,
+    ).start()
+    mc = lsp.Client("127.0.0.1", server.port, PARAMS, label="m1")
+    threading.Thread(
+        target=miner_mod.run_miner, args=(mc, min_hash_range), daemon=True
+    ).start()
+    # Exporter label defaults to tele-m1: the partition below cuts ONLY
+    # the sidecar endpoint, not the miner's serving conn.
+    exp = TelemetryExporter(
+        "127.0.0.1", hub.port, "m1", interval=0.1, params=PARAMS
+    ).start()
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            src = hub.fleet.sources().get("m1")
+            if src and not src["stale"]:
+                break
+            time.sleep(0.05)
+        assert hub.fleet.sources().get("m1"), "telemetry never arrived"
+        # Chaos: ambient burst loss everywhere + sidecar blackhole.
+        CHAOS.set_conditions(
+            ge=GEParams(p_enter_bad=3, p_exit_bad=12, loss_bad=90)
+        )
+        CHAOS.partition("tele-m1", "both")
+        # Miners keep sweeping: a job issued DURING the partition+loss
+        # completes bit-exact (loss only costs retransmits).
+        c = lsp.Client("127.0.0.1", server.port, PARAMS, label="client-0")
+        try:
+            res = client_mod.request_once(c, "telechaos", 3000)
+        finally:
+            c.close()
+        assert res == min_hash_range("telechaos", 0, 3000)
+        # The partitioned source ages out as stale — observed through the
+        # hub the SERVE TICKER drives, so a stale source passing through
+        # here also proves no serve-loop tick blocked on telemetry.
+        deadline = time.time() + 20
+        stale_state = None
+        while time.time() < deadline:
+            st = hub.last_state()
+            if st and st["per_source"].get("m1", {}).get("stale"):
+                stale_state = st
+                break
+            time.sleep(0.1)
+        assert stale_state is not None, hub.last_state()
+        assert stale_state["stale_sources"] >= 1
+        assert METRICS.gauge("fleet.sources_stale") >= 1
+        # Heal: the exporter's reconnect loop re-delivers and the source
+        # returns fresh (seq restarts at 1; the view accepts it).
+        CHAOS.reset()
+        deadline = time.time() + 30
+        back = False
+        while time.time() < deadline:
+            src = hub.fleet.sources().get("m1")
+            if src and not src["stale"]:
+                back = True
+                break
+            time.sleep(0.1)
+        assert back, hub.fleet.sources()
+    finally:
+        exp.stop()
+        CHAOS.reset()
+        server.close()
+        hub.close()
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize(
     "scenario,seed,kill_at",

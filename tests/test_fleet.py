@@ -1,0 +1,795 @@
+"""Fleet metrics plane suite (ISSUE 7): telemetry sidecar, merged fleet
+view, SLO burn-rate alerts, straggler detection, dashboard.
+
+Four layers:
+
+1. **Mergeable state** — Histogram state round-trips, FleetView merge
+   semantics (counters sum, gauges LWW, histograms merge), staleness
+   aging, Prometheus exposition.
+2. **SLO engine** — burn-rate math on a fake clock: an outage fires the
+   multi-window alert and recovery resolves it; a fast-window spike
+   alone never pages; no evidence is not an outage.
+3. **Channel** — fragment framing round-trips under the frozen
+   1000-byte LSP wire ceiling; a real exporter→hub loopback merges; a
+   subscriber (the dash --connect path) receives published states.
+4. **The acceptance drill** — a real in-process fleet with an induced
+   straggler under seeded burst loss fires the SLO alert and the
+   detector names the induced miner; the clean run stays alert-quiet.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from bitcoin_miner_tpu import lsp, lspnet
+from bitcoin_miner_tpu.apps import client as client_mod
+from bitcoin_miner_tpu.apps import miner as miner_mod
+from bitcoin_miner_tpu.apps import server as server_mod
+from bitcoin_miner_tpu.apps.scheduler import Scheduler
+from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+from bitcoin_miner_tpu.lspnet.chaos import CHAOS, GEParams
+from bitcoin_miner_tpu.utils.fleetview import FleetView, render_prometheus
+from bitcoin_miner_tpu.utils.metrics import (
+    METRICS,
+    Histogram,
+    Metrics,
+    format_quantiles,
+)
+from bitcoin_miner_tpu.utils.slo import (
+    SloEngine,
+    SloSpec,
+    default_slos,
+    parse_slo_config,
+)
+from bitcoin_miner_tpu.utils.telemetry import (
+    FrameAssembler,
+    TelemetryExporter,
+    TelemetryHub,
+    encode_frames,
+    encode_subscribe,
+)
+
+pytestmark = pytest.mark.fleet
+
+PARAMS = lsp.Params(epoch_limit=5, epoch_millis=100, window_size=5)
+
+
+@pytest.fixture(autouse=True)
+def _clean_network():
+    lspnet.reset_faults()
+    CHAOS.reset()
+    yield
+    CHAOS.reset()
+    lspnet.reset_faults()
+
+
+def _hist_of(samples):
+    h = Histogram()
+    for s in samples:
+        h.observe(s)
+    return h
+
+
+# --------------------------------------------------------------------------
+# 1. Mergeable state + fleet view
+# --------------------------------------------------------------------------
+
+
+def test_histogram_state_roundtrips_and_merges():
+    h = _hist_of([0.001, 0.5, 0.5, 2.0])
+    h2 = Histogram.from_state(h.state())
+    assert h2.buckets() == h.buckets()
+    assert h2.count() == h.count()
+    assert h2.quantile(0.5) == h.quantile(0.5)
+    # state survives a JSON round-trip (the wire format)
+    h3 = Histogram.from_state(json.loads(json.dumps(h.state())))
+    assert h3.buckets() == h.buckets()
+
+
+def test_histogram_from_state_tolerates_garbage():
+    for bad in ({}, {"buckets": "x"}, {"buckets": {"a": "b"}}, "junk", None,
+                {"buckets": {"1": 2}, "count": "many"}):
+        h = Histogram.from_state(bad)
+        assert h.count() in (0,) or isinstance(h.count(), int)
+    assert Histogram.from_state({"buckets": "x"}).count() == 0
+
+
+def test_histogram_count_above():
+    h = _hist_of([0.1, 0.1, 0.3, 1.0, 4.0])
+    assert h.count_above(0.5) == 2
+    assert h.count_above(0.05) == 5
+    assert h.count_above(100.0) == 0
+    assert h.count_above(0.0) == h.count()
+
+
+def test_fleetview_counters_sum_gauges_lww_hists_merge():
+    fv = FleetView(staleness_s=10.0, clock=lambda: 0.0)
+    h = _hist_of([0.5])
+    fv.ingest("a", {"seq": 1, "counters": {"n": 1}, "gauges": {"g": 1.0},
+                    "hists": {"hist.x": h.state()}}, now=0.0)
+    fv.ingest("b", {"seq": 1, "counters": {"n": 2}, "gauges": {"g": 7.0},
+                    "hists": {"hist.x": h.state()}}, now=1.0)
+    m = fv.merged(now=1.0)
+    assert m["counters"]["n"] == 3
+    assert m["gauges"]["g"] == 7.0  # freshest write wins
+    assert m["hists"]["hist.x"].count() == 2
+    assert m["sources"] == 2 and m["stale_sources"] == 0
+
+
+def test_fleetview_staleness_ages_gauges_out_keeps_counters():
+    fv = FleetView(staleness_s=5.0, clock=lambda: 0.0)
+    fv.ingest("old", {"seq": 1, "counters": {"n": 10}, "gauges": {"g": 3.0},
+                      "hists": {"hist.x": _hist_of([1.0]).state()}}, now=0.0)
+    fv.ingest("new", {"seq": 1, "counters": {"n": 1}}, now=6.0)
+    m = fv.merged(now=6.0)
+    assert m["stale_sources"] == 1 and m["sources"] == 1
+    # cumulative totals stand; point-in-time views age out
+    assert m["counters"]["n"] == 11
+    assert "g" not in m["gauges"]
+    assert "hist.x" not in m["hists"]
+    src = fv.sources(now=6.0)
+    assert src["old"]["stale"] and not src["new"]["stale"]
+
+
+def test_fleetview_drops_replayed_seq_accepts_reconnect_restart():
+    fv = FleetView(staleness_s=10.0, clock=lambda: 0.0)
+    assert fv.ingest("m", {"seq": 5, "counters": {"n": 5}}, now=0.0)
+    assert not fv.ingest("m", {"seq": 4, "counters": {"n": 99}}, now=1.0)
+    assert fv.merged(now=1.0)["counters"]["n"] == 5
+    # a reconnected exporter restarts at seq 1: always accepted
+    assert fv.ingest("m", {"seq": 1, "counters": {"n": 6}}, now=2.0)
+    assert fv.merged(now=2.0)["counters"]["n"] == 6
+
+
+def test_straggler_detector_names_the_slow_source_only():
+    fv = FleetView(staleness_s=60.0, clock=lambda: 0.0)
+    for name, scale in (("m0", 0.01), ("m1", 0.012), ("m2", 0.011),
+                        ("slowpoke", 0.4)):
+        h = _hist_of([scale * (1 + 0.1 * (i % 3)) for i in range(20)])
+        fv.ingest(name, {"seq": 1,
+                         "hists": {"hist.miner_chunk_s": h.state()}}, now=0.0)
+    out = fv.stragglers(now=0.0)
+    assert [s["source"] for s in out] == ["slowpoke"]
+    assert out[0]["ratio"] > 3.0
+    # exclusion drops it from consideration entirely
+    assert fv.stragglers(now=0.0, exclude=("slowpoke",)) == []
+
+
+def test_straggler_detector_guards():
+    fv = FleetView(staleness_s=60.0, clock=lambda: 0.0)
+    # below min_samples: no verdicts, however skewed
+    fv.ingest("a", {"seq": 1,
+                    "hists": {"hist.miner_chunk_s": _hist_of([9.0]).state()}},
+              now=0.0)
+    fv.ingest("b", {"seq": 1,
+                    "hists": {"hist.miner_chunk_s": _hist_of([0.1]).state()}},
+              now=0.0)
+    assert fv.stragglers(now=0.0, min_samples=8) == []
+    # a single source has no peers to be slower than
+    fv2 = FleetView(staleness_s=60.0, clock=lambda: 0.0)
+    h = _hist_of([1.0] * 20)
+    fv2.ingest("only", {"seq": 1, "hists": {"hist.miner_chunk_s": h.state()}},
+               now=0.0)
+    assert fv2.stragglers(now=0.0) == []
+
+
+def test_render_prometheus_exposition():
+    fv = FleetView(staleness_s=10.0, clock=lambda: 0.0)
+    fv.ingest("m", {"seq": 1, "counters": {"sched.jobs_completed": 3},
+                    "gauges": {"gauge.miners_live": 2.0},
+                    "hists": {"hist.request_s": _hist_of([0.5, 1.0]).state()}},
+              now=0.0)
+    text = render_prometheus(fv.merged(now=0.0))
+    assert "# TYPE bmt_sched_jobs_completed counter" in text
+    assert "bmt_sched_jobs_completed 3" in text
+    assert "bmt_gauge_miners_live 2" in text
+    assert "# TYPE bmt_hist_request_s histogram" in text
+    assert 'bmt_hist_request_s_bucket{le="+Inf"} 2' in text
+    assert "bmt_hist_request_s_count 2" in text
+    assert "bmt_fleet_sources 1" in text
+    # cumulative buckets are monotone non-decreasing
+    cums = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+            if line.startswith("bmt_hist_request_s_bucket")]
+    assert cums == sorted(cums)
+
+
+# --------------------------------------------------------------------------
+# 2. SLO engine
+# --------------------------------------------------------------------------
+
+
+def _latency_spec(**kw):
+    base = dict(
+        name="req", kind="latency", objective=0.95, hist="hist.request_s",
+        threshold_s=0.5, fast_window_s=2.0, slow_window_s=6.0,
+        burn_threshold=2.0, min_events=2,
+    )
+    base.update(kw)
+    return SloSpec(**base)
+
+
+def _feed(engine, fv, clock, t, hist):
+    clock[0] = t
+    fv.ingest("gw", {"seq": 1, "hists": {"hist.request_s": hist.state()}},
+              now=t)
+    return engine.tick(fv, now=t)
+
+
+def test_slo_outage_fires_multi_window_alert_then_resolves():
+    clock = [0.0]
+    engine = SloEngine([_latency_spec()], clock=lambda: clock[0])
+    fv = FleetView(staleness_s=1e6, clock=lambda: clock[0])
+    fired0 = METRICS.get("slo.alerts_fired")
+    resolved0 = METRICS.get("slo.alerts_resolved")
+    h = Histogram()
+    out = None
+    for t in range(0, 8):  # sustained outage: every sample above threshold
+        h.observe(5.0)
+        out = _feed(engine, fv, clock, float(t), h)
+    assert out["alerts"] == ["req"], out
+    assert engine.verdicts() == {"req": False}
+    assert METRICS.get("slo.alerts_fired") == fired0 + 1
+    # recovery: a flood of good samples drains both windows' bad fraction
+    for t in range(8, 40):
+        for _ in range(50):
+            h.observe(0.01)
+        out = _feed(engine, fv, clock, float(t), h)
+    assert out["alerts"] == [], out
+    assert engine.verdicts() == {"req": True}
+    assert METRICS.get("slo.alerts_resolved") == resolved0 + 1
+
+
+def test_slo_fast_spike_alone_does_not_page():
+    """The multi-window property: a burst that fills the fast window but
+    not the slow one (long good history behind it) stays quiet."""
+    clock = [0.0]
+    engine = SloEngine(
+        [_latency_spec(fast_window_s=1.0, slow_window_s=30.0,
+                       burn_threshold=3.0)],
+        clock=lambda: clock[0],
+    )
+    fv = FleetView(staleness_s=1e6, clock=lambda: clock[0])
+    h = Histogram()
+    for t in range(0, 25):  # long healthy history
+        for _ in range(20):
+            h.observe(0.01)
+        _feed(engine, fv, clock, float(t), h)
+    out = None
+    for t in range(25, 27):  # 2s spike
+        for _ in range(5):
+            h.observe(5.0)
+        out = _feed(engine, fv, clock, float(t), h)
+    assert out["alerts"] == [], out
+    st = [s for s in out["slos"] if s["name"] == "req"][0]
+    assert st["burn_fast"] > 3.0  # the spike IS visible fast...
+    assert st["burn_slow"] < 3.0  # ...but the slow window vetoes the page
+
+
+def test_slo_no_evidence_is_not_an_outage():
+    clock = [0.0]
+    engine = SloEngine([_latency_spec(min_events=4)], clock=lambda: clock[0])
+    fv = FleetView(staleness_s=1e6, clock=lambda: clock[0])
+    h = Histogram()
+    h.observe(9.0)  # one bad sample, below min_events
+    out = _feed(engine, fv, clock, 0.0, h)
+    st = out["slos"][0]
+    assert st["burn_fast"] == 0.0 and not st["firing"]
+
+
+def test_slo_ratio_orphan_rate():
+    clock = [0.0]
+    spec = SloSpec(
+        "orphans", "ratio", objective=0.9,
+        bad=("sched.jobs_orphaned",),
+        total=("sched.jobs_completed", "sched.jobs_orphaned"),
+        fast_window_s=2.0, slow_window_s=6.0, burn_threshold=2.0,
+        min_events=2,
+    )
+    engine = SloEngine([spec], clock=lambda: clock[0])
+    fv = FleetView(staleness_s=1e6, clock=lambda: clock[0])
+    done, orphaned = 0, 0
+    out = None
+    for t in range(0, 8):
+        clock[0] = float(t)
+        done += 1
+        orphaned += 1  # 50% orphan rate >> 10% budget
+        fv.ingest("s", {"seq": 1, "counters": {
+            "sched.jobs_completed": done, "sched.jobs_orphaned": orphaned,
+        }}, now=clock[0])
+        out = engine.tick(fv, now=clock[0])
+    assert out["alerts"] == ["orphans"]
+
+
+def test_slo_liveness_counts_stale_sources():
+    clock = [0.0]
+    spec = SloSpec(
+        "live", "liveness", objective=0.6, fast_window_s=2.0,
+        slow_window_s=6.0, burn_threshold=1.0, min_events=2,
+    )
+    engine = SloEngine([spec], clock=lambda: clock[0])
+    fv = FleetView(staleness_s=1.0, clock=lambda: clock[0])
+    fv.ingest("gone", {"seq": 1}, now=0.0)
+    fv.ingest("here", {"seq": 1}, now=0.0)
+    out = None
+    for t in range(0, 8):
+        clock[0] = float(t)
+        fv.ingest("here", {"seq": 1 + t}, now=clock[0])
+        out = engine.tick(fv, now=clock[0])  # "gone" stale from t>=2
+    assert out["alerts"] == ["live"], out
+
+
+def test_slo_liveness_excludes_the_hubs_own_source():
+    """Regression: the server ingests its own registry every tick, so it
+    is always fresh — counting it would dilute a dead miner's stale
+    fraction (1 dead of {miner, server} = 0.5 -> burn 5 < default 6:
+    a fully dead fleet member never pages).  The hub passes exclude=
+    (its source,) and liveness must honor it."""
+    clock = [0.0]
+    spec = SloSpec(
+        "live", "liveness", objective=0.9, fast_window_s=2.0,
+        slow_window_s=6.0, burn_threshold=6.0, min_events=2,
+    )
+    engine = SloEngine([spec], clock=lambda: clock[0])
+    fv = FleetView(staleness_s=1.0, clock=lambda: clock[0])
+    fv.ingest("miner", {"seq": 1}, now=0.0)
+    out = None
+    for t in range(0, 8):
+        clock[0] = float(t)
+        fv.ingest("server", {"seq": 1 + t}, now=clock[0])  # always fresh
+        out = engine.tick(fv, now=clock[0], exclude=("server",))
+    # the one real fleet member is 100% stale: burn 1.0/0.1 = 10 > 6
+    assert out["alerts"] == ["live"], out
+
+
+def test_slo_latency_evidence_is_monotonic_across_staleness():
+    """Regression: SLO evidence diffs CUMULATIVE totals, so it must come
+    from the include_stale merge — with a fresh-only view, a source
+    carrying old bad samples that goes silent past the window and then
+    reconnects UNCHANGED would re-add its whole history as one step and
+    fire an alert with zero new events."""
+    clock = [0.0]
+    engine = SloEngine(
+        [_latency_spec(fast_window_s=5.0, slow_window_s=20.0,
+                       burn_threshold=2.0)],
+        clock=lambda: clock[0],
+    )
+    fv = FleetView(staleness_s=2.0, clock=lambda: clock[0])
+    h = _hist_of([5.0] * 40 + [0.01] * 60)  # old mixed history: 40% bad
+    st = h.state()
+    fired0 = METRICS.get("slo.alerts_fired")
+    fv.ingest("m", {"seq": 1, "hists": {"hist.request_s": st}}, now=0.0)
+    out = engine.tick(fv, now=0.0)
+    # silence well past every window: the source goes stale
+    for t in (10.0, 20.0, 30.0):
+        clock[0] = t
+        out = engine.tick(fv, now=t)
+        assert out["alerts"] == [], out
+    # reconnect with the IDENTICAL cumulative state: no new events, so
+    # no window may see a delta and nothing may fire
+    clock[0] = 31.0
+    fv.ingest("m", {"seq": 1, "hists": {"hist.request_s": st}}, now=31.0)
+    for t in (31.0, 32.0, 33.0):
+        clock[0] = t
+        out = engine.tick(fv, now=t)
+        assert out["alerts"] == [], out
+    assert METRICS.get("slo.alerts_fired") == fired0
+
+
+def test_parse_slo_config_vocabulary():
+    assert [s.name for s in parse_slo_config("")] == [
+        "request-p95", "chunk-rtt-p95", "orphan-rate", "miner-liveness"]
+    specs = parse_slo_config("req_p95=0.25,window=5/20,burn=2,orphan=0.02")
+    by = {s.name: s for s in specs}
+    assert by["request-p95"].threshold_s == 0.25
+    assert by["request-p95"].fast_window_s == 5.0
+    assert by["request-p95"].slow_window_s == 20.0
+    assert by["request-p95"].burn_threshold == 2.0
+    assert by["orphan-rate"].objective == pytest.approx(0.98)
+    with pytest.raises(ValueError):
+        parse_slo_config("nonsense=1")
+    with pytest.raises(ValueError):
+        parse_slo_config("req_p95")
+
+
+# --------------------------------------------------------------------------
+# 3. The channel: framing + exporter→hub loopback + subscriber stream
+# --------------------------------------------------------------------------
+
+
+def test_frames_roundtrip_under_wire_ceiling():
+    big = {"v": 1, "source": "x", "blob": os.urandom(3000).hex()}
+    frames = encode_frames(big, 42)
+    assert len(frames) > 1
+    # every fragment's marshaled LSP datagram must fit the frozen
+    # 1000-byte read-buffer ceiling (lsp.MAX_MESSAGE_SIZE)
+    from bitcoin_miner_tpu.lsp.message import Message as LspMessage
+
+    for i, f in enumerate(frames):
+        wire = LspMessage.data(999999, 999999, len(f), f).marshal()
+        assert len(wire) <= lsp.MAX_MESSAGE_SIZE, (i, len(wire))
+    asm = FrameAssembler()
+    outs = [asm.feed(f) for f in frames]
+    assert outs[-1] == (True, big)
+    assert all(done is False for done, _ in outs[:-1])
+
+
+def test_frame_assembler_tolerates_garbage_and_torn_streams():
+    asm = FrameAssembler()
+    assert asm.feed(b"T1|x|y|z|junk") == (True, None)
+    assert asm.feed(b"\xff\xferaw")[0] is True
+    a, b = encode_frames({"v": 1, "source": "a",
+                          "blob": os.urandom(600).hex()}, 1)[:2]
+    # torn stream: first fragment of msg 1, then a fresh msg 2 restarts
+    assert asm.feed(a) == (False, None)
+    small = {"v": 1, "source": "b"}
+    (frame,) = encode_frames(small, 2)
+    assert asm.feed(frame) == (True, small)
+    # joining mid-message is dropped, then recovery works
+    assert asm.feed(b)[1] is None
+    assert asm.feed(frame) == (True, small)
+
+
+def test_frame_assembler_counts_one_loss_per_message_not_per_fragment():
+    """Regression: a lost 8-fragment message must show as ONE decode
+    error, not 7 — the counter an operator judges channel health by
+    must not over-report by the fragmentation factor."""
+    frames = encode_frames(
+        {"v": 1, "source": "a", "blob": os.urandom(2000).hex()}, 9
+    )
+    assert len(frames) >= 4
+    asm = FrameAssembler()
+    # joined mid-message: fragment 1..n of msg 9 without fragment 0
+    outs = [asm.feed(f) for f in frames[1:]]
+    assert outs[0] == (True, None)  # the one reported loss
+    assert all(o == (False, None) for o in outs[1:])  # silently skipped
+    # a fresh complete message afterwards still assembles
+    small = {"v": 1, "source": "b"}
+    (frame,) = encode_frames(small, 10)
+    assert asm.feed(frame) == (True, small)
+
+
+def test_frame_assembler_bounds_hostile_input():
+    """The ingest port is unauthenticated: a peer declaring a billion
+    fragments or shipping a zlib bomb must be dropped, not buffered or
+    inflated."""
+    import zlib as _zlib
+
+    from bitcoin_miner_tpu.utils.telemetry import _FRAG_LIMIT, _MAX_MSG_BYTES
+
+    asm = FrameAssembler()
+    bomb_header = b"T1|1|0|1000000000|" + b"x" * 100
+    assert asm.feed(bomb_header) == (True, None)
+    assert asm._parts == []  # nothing buffered
+    # zlib bomb: ~100KB compressed -> ~1GB decompressed must not inflate
+    blob = _zlib.compress(b"\x00" * (_MAX_MSG_BYTES * 4))
+    n = (len(blob) + 479) // 480
+    assert n <= _FRAG_LIMIT
+    frames = [
+        b"T1|2|%d|%d|" % (i, n) + blob[i * 480:(i + 1) * 480]
+        for i in range(n)
+    ]
+    outs = [asm.feed(f) for f in frames]
+    assert outs[-1] == (True, None)  # dropped at the inflate cap
+
+
+def test_exporter_hub_loopback_merges_and_publishes():
+    tmp_log = None
+    hub = TelemetryHub(
+        0, params=PARAMS, slo=SloEngine(default_slos()),
+        publish_interval=0.1, source=None,
+    ).start(self_tick=0.1)
+    reg = Metrics()
+    reg.inc("miner.nonces", 77)
+    for i in range(30):
+        reg.observe("hist.miner_chunk_s", 0.05 * (1 + i % 3))
+    exp = TelemetryExporter(
+        "127.0.0.1", hub.port, "m1", interval=0.1, params=PARAMS,
+        registry=reg,
+    ).start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            st = hub.last_state()
+            if st and st["counters"].get("miner.nonces") == 77:
+                break
+            time.sleep(0.05)
+        st = hub.last_state()
+        assert st and st["counters"].get("miner.nonces") == 77, st
+        assert st["hists"]["hist.miner_chunk_s"]["count"] == 30
+        assert st["slo"]["alerts"] == []
+        assert st["per_source"]["m1"]["stale"] is False
+        # subscriber stream: the tools.dash --connect path
+        c = lsp.Client("127.0.0.1", hub.port, PARAMS)
+        try:
+            c.write(encode_subscribe())
+            asm = FrameAssembler()
+            got = None
+            deadline = time.time() + 15
+            while got is None and time.time() < deadline:
+                done, obj = asm.feed(c.read())
+                if done and isinstance(obj, dict):
+                    got = obj
+            assert got is not None and "counters" in got and "sources" in got
+        finally:
+            c.close()
+    finally:
+        exp.stop()
+        hub.close()
+
+
+def test_hub_publish_sinks_fleet_log_and_prom(tmp_path):
+    fleet_log = str(tmp_path / "fleet.jsonl")
+    prom = str(tmp_path / "metrics.prom")
+    reg = Metrics()
+    reg.inc("sched.jobs_completed", 2)
+    hub = TelemetryHub(
+        0, params=PARAMS, publish_interval=0.0, source="server",
+        registry=reg, fleet_log=fleet_log, prom_path=prom,
+    ).start()
+    try:
+        hub.tick()
+        hub.tick()
+        rows = [json.loads(line) for line in open(fleet_log)]
+        assert rows and rows[-1]["counters"]["sched.jobs_completed"] == 2
+        text = open(prom).read()
+        assert "bmt_sched_jobs_completed 2" in text
+    finally:
+        hub.close()
+
+
+# --------------------------------------------------------------------------
+# 4. Dashboard rendering
+# --------------------------------------------------------------------------
+
+
+def _sample_state():
+    return {
+        "sources": 2, "stale_sources": 1,
+        "per_source": {
+            "m1": {"age_s": 0.5, "stale": False, "seq": 9},
+            "m2": {"age_s": 22.0, "stale": True, "seq": 4},
+            "server": {"age_s": 0.1, "stale": False, "seq": -1},
+        },
+        "counters": {"sched.jobs_completed": 5, "telemetry.exports": 9},
+        "gauges": {"gauge.miners_live": 2.0},
+        "hists": {
+            "hist.request_s": {"count": 4, "mean": 0.2, "p50": 0.2,
+                               "p95": 0.4, "p99": 0.4},
+            "hist.chunk_rtt_s": {"count": 0, "mean": 0.0, "p50": 0.0,
+                                 "p95": 0.0, "p99": 0.0},
+        },
+        "stragglers": [{"source": "m2", "p50_s": 1.2, "fleet_p50_s": 0.2,
+                        "ratio": 6.0, "samples": 12}],
+        "slo": {
+            "slos": [
+                {"name": "request-p95", "kind": "latency", "objective": 0.95,
+                 "burn_fast": 8.2, "burn_slow": 7.1, "window_events": 40,
+                 "firing": True, "ok": False},
+            ],
+            "alerts": ["request-p95"],
+        },
+    }
+
+
+def test_dash_render_frame_shows_slo_stragglers_and_dashes():
+    from tools.dash import render_frame
+
+    frame = render_frame(_sample_state())
+    assert "2/3 sources fresh" in frame
+    assert "STALE" in frame
+    assert "request-p95" in frame and "ALERT" in frame
+    assert "m2" in frame and "6.0x" in frame
+    # the empty histogram renders -, never a misleading 0 (ISSUE 7)
+    assert "-/-/-" in frame
+    assert "sched.jobs_completed" in frame
+
+
+def test_dash_follow_waits_for_a_fleet_log_that_does_not_exist_yet(tmp_path):
+    """Regression: --follow races the server's FIRST publish (the hub
+    creates the file on its first rate-limited beat) — follow mode must
+    wait for the file, not die on FileNotFoundError."""
+    from tools.dash import _states_from_file
+
+    path = tmp_path / "later.jsonl"
+    gen = _states_from_file(str(path), follow=True, poll_s=0.05)
+
+    def _create():
+        time.sleep(0.2)
+        with open(path, "w") as f:
+            f.write(json.dumps({"sources": 1, "stale_sources": 0}) + "\n")
+
+    t = threading.Thread(target=_create, daemon=True)
+    t.start()
+    state = next(gen)
+    assert state["sources"] == 1
+    # non-follow mode on a missing file still reports the error
+    with pytest.raises(SystemExit):
+        next(_states_from_file(str(tmp_path / "nope.jsonl"), follow=False,
+                               poll_s=0.05))
+
+
+def test_dash_main_once_reads_fleet_log(tmp_path, capsys):
+    from tools.dash import main as dash_main
+
+    path = tmp_path / "fleet.jsonl"
+    with open(path, "w") as f:
+        f.write("this line is torn garbage\n")
+        f.write(json.dumps(_sample_state()) + "\n")
+        f.write('{"half": ')  # torn tail: skipped
+    assert dash_main([str(path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "sources fresh" in out and "request-p95" in out
+    # an empty file reports no state
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert dash_main([str(empty), "--once"]) == 1
+
+
+def test_loadgen_rejects_combined_overhead_flags():
+    """The two overhead modes share one bare comparison leg; combining
+    them would misattribute the planes' combined cost to each number."""
+    import tools.loadgen as loadgen
+
+    with pytest.raises(SystemExit):
+        loadgen.main(["--fast", "--telemetry-overhead", "--trace-overhead"])
+
+
+def test_server_main_reports_busy_telemetry_port_cleanly(capsys):
+    """A busy --telemetry-port gets the same friendly one-line error as a
+    busy serving port — never a traceback."""
+    squatter = lsp.Server(0, PARAMS)
+    try:
+        rc = server_mod.main(
+            ["server", "0", f"--telemetry-port={squatter.port}"]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Traceback" not in out
+        assert "Server listening on port" in out  # serving port was fine
+    finally:
+        squatter.close()
+
+
+# --------------------------------------------------------------------------
+# 5. The acceptance drill: induced straggler + burst loss vs clean run
+# --------------------------------------------------------------------------
+
+
+def _run_drill_fleet(slow_idx, chaos_seed, data, n_miners=3, jobs=4,
+                     max_nonce=1500):
+    """A real loopback fleet with per-miner telemetry registries.  The
+    ``slow_idx`` miner sleeps 1.5 s per chunk (the induced straggler);
+    ``chaos_seed`` arms seeded Gilbert–Elliott burst loss on the wire.
+    The chunk-RTT objective sits at 0.75 s: half the induced latency
+    (every straggler chunk is definitively bad) but far above anything a
+    healthy loopback chunk hits even on a loaded CI box — the clean leg
+    must stay quiet without wall-clock luck.
+    Returns (results, final hub state, alerts seen at any tick)."""
+    if chaos_seed is not None:
+        # Sustained (not scheduled) burst loss for the whole drill — mild
+        # enough that LSP retransmits ride it out, bursty enough to be a
+        # real degraded-network leg.
+        CHAOS.seed(chaos_seed)
+        CHAOS.set_conditions(
+            ge=GEParams(p_enter_bad=4, p_exit_bad=25, loss_bad=60)
+        )
+    engine = SloEngine(default_slos(
+        chunk_threshold_s=0.75, fast_window_s=3.0, slow_window_s=8.0,
+        burn_threshold=2.0, min_events=3,
+    ))
+    hub = TelemetryHub(
+        0, params=PARAMS, slo=engine, publish_interval=0.2,
+        straggler_min_samples=4,
+    ).start()
+    server = lsp.Server(0, PARAMS, label="server")
+    threading.Thread(
+        target=server_mod.serve,
+        args=(server, Scheduler(min_chunk=300, max_chunk=300,
+                                straggler_min_seconds=30.0)),
+        kwargs={"tick_interval": 0.1, "health_interval": 1.0,
+                "telemetry": hub},
+        daemon=True,
+    ).start()
+    exporters = []
+    stop_evt = threading.Event()
+    try:
+        for i in range(n_miners):
+            reg = Metrics()
+
+            def search(d, lo, hi, _i=i, _reg=reg):
+                t0 = time.monotonic()
+                if _i == slow_idx:
+                    time.sleep(1.5)
+                r = min_hash_range(d, lo, hi)
+                _reg.observe("hist.miner_chunk_s", time.monotonic() - t0)
+                return r
+
+            # The self-healing miner lifetime: burst loss may kill a conn
+            # mid-drill and the re-Join machinery (PR 2) rides it out.
+            threading.Thread(
+                target=miner_mod.run_miner_resilient,
+                args=("127.0.0.1", server.port, search),
+                kwargs={"params": PARAMS, "max_retries": 10,
+                        "backoff_base": 0.05, "backoff_cap": 0.3,
+                        "label": f"miner-{i}", "stop": stop_evt},
+                daemon=True,
+            ).start()
+            exporters.append(TelemetryExporter(
+                "127.0.0.1", hub.port, f"m{i}", interval=0.15,
+                params=PARAMS, registry=reg,
+            ).start())
+        results = []
+        alerts_seen = set()
+        stragglers_seen = set()
+        for j in range(jobs):
+            results.append(
+                (f"{data}{j}",
+                 client_mod.request_with_retry(
+                     "127.0.0.1", server.port, f"{data}{j}", max_nonce,
+                     retries=5, backoff_base=0.1, params=PARAMS,
+                     label=f"client-{j}",
+                 ))
+            )
+            st = hub.last_state()
+            if st:
+                alerts_seen.update(st.get("slo", {}).get("alerts", []))
+                stragglers_seen.update(
+                    s["source"] for s in st.get("stragglers", [])
+                )
+        # a few extra beats so the last chunks' evidence lands
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            st = hub.last_state()
+            if st:
+                alerts_seen.update(st.get("slo", {}).get("alerts", []))
+                stragglers_seen.update(
+                    s["source"] for s in st.get("stragglers", [])
+                )
+            if (slow_idx is None) or (
+                alerts_seen and f"m{slow_idx}" in stragglers_seen
+            ):
+                break
+            time.sleep(0.1)
+        return results, hub.last_state(), alerts_seen, stragglers_seen
+    finally:
+        stop_evt.set()
+        for e in exporters:
+            e.stop()
+        CHAOS.reset()
+        server.close()
+        hub.close()
+
+
+@pytest.mark.chaos
+def test_acceptance_drill_straggler_and_burst_loss_fire_alert():
+    """ISSUE 7 acceptance: the seeded drill (induced straggler m2 +
+    Gilbert–Elliott burst loss) fires the chunk-RTT burn-rate alert and
+    the straggler detector names the induced miner — with every Result
+    still bit-exact."""
+    fired0 = METRICS.get("slo.alerts_fired")
+    results, state, alerts, stragglers = _run_drill_fleet(
+        slow_idx=2, chaos_seed=11, data="drillhot"
+    )
+    for data, got in results:
+        assert got == min_hash_range(data, 0, 1500), data
+    assert "chunk-rtt-p95" in alerts, (alerts, state and state.get("slo"))
+    assert "m2" in stragglers, (stragglers, state)
+    assert METRICS.get("slo.alerts_fired") > fired0
+
+
+@pytest.mark.chaos
+def test_acceptance_drill_clean_run_stays_quiet():
+    """The control leg: same fleet, no straggler, no chaos — every SLO
+    quiet and nobody flagged."""
+    fired0 = METRICS.get("slo.alerts_fired")
+    results, state, alerts, stragglers = _run_drill_fleet(
+        slow_idx=None, chaos_seed=None, data="drillcold"
+    )
+    for data, got in results:
+        assert got == min_hash_range(data, 0, 1500), data
+    assert alerts == set(), (alerts, state and state.get("slo"))
+    assert stragglers == set(), stragglers
+    assert METRICS.get("slo.alerts_fired") == fired0

@@ -6,7 +6,13 @@ import pytest
 
 from bitcoin_miner_tpu import lsp, lspnet
 from bitcoin_miner_tpu.apps.scheduler import Scheduler
-from bitcoin_miner_tpu.utils.metrics import METRICS, Metrics, RateMeter
+from bitcoin_miner_tpu.utils.metrics import (
+    METRICS,
+    Histogram,
+    Metrics,
+    RateMeter,
+    format_quantiles,
+)
 
 
 def test_counter_basics():
@@ -17,6 +23,25 @@ def test_counter_basics():
     assert m.snapshot() == {"a": 5}
     m.reset()
     assert m.get("a") == 0
+
+
+def test_empty_histogram_renders_dashes_not_zero():
+    """ISSUE 7 satellite regression: a histogram with ZERO samples must
+    render its quantiles as ``-`` on the health line / dashboard — its
+    ``snapshot()`` p50/p95/p99 are numerically 0, and printing those
+    reads as "instant" when the truth is "no data"."""
+    h = Histogram()
+    assert h.snapshot()["p50"] == 0.0  # the misleading raw number
+    assert format_quantiles(h) == "-/-/-"
+    assert format_quantiles(None) == "-/-/-"  # absent histogram too
+    assert format_quantiles(h.snapshot()) == "-/-/-"  # snapshot-dict form
+    h.observe(1.0)
+    rendered = format_quantiles(h)
+    assert "-" not in rendered and rendered.count("/") == 2
+    # a populated zero bucket is REAL data: 0 is then the honest render
+    z = Histogram()
+    z.observe(0.0)
+    assert format_quantiles(z) == "0/0/0"
 
 
 def test_rate_meter():

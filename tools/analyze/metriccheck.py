@@ -15,8 +15,9 @@ Rules:
 - ``metric-unused`` — a registry name no emitter anywhere ever emits
   (documented-but-never-incremented: dead doc or a dropped call site).
 - ``metric-kind-mismatch`` — the emitter does not match the name's kind:
-  ``hist.*`` names take ``observe``, ``gauge.*`` names take
-  ``set_gauge``, everything else takes ``inc``.
+  ``hist.*`` names take ``observe``, ``gauge.*`` AND ``fleet.*`` names
+  take ``set_gauge`` (the merged fleet-view levels the telemetry hub
+  publishes, ISSUE 7), everything else takes ``inc``.
 - ``metric-dynamic-name`` — an emitter whose name argument is not a
   string literal (a computed name can never be registry-checked; read
   paths like ``METRICS.get(f"sched.{k}")`` are exempt — only emitters
@@ -61,7 +62,7 @@ _METRICS_ASSIGN = re.compile(r"^METRICS\s*=", re.MULTILINE)
 def _name_kind(name: str) -> str:
     if name.startswith("hist."):
         return "hist"
-    if name.startswith("gauge."):
+    if name.startswith(("gauge.", "fleet.")):
         return "gauge"
     return "counter"
 
@@ -208,8 +209,8 @@ def run(root: Path, scan_dirs: Optional[Tuple[str, ...]] = None) -> List[Finding
                     line,
                     name,
                     f"emitted via {method}() but the name's prefix says "
-                    f"{_name_kind(name)} (hist.* -> observe, gauge.* -> "
-                    f"set_gauge, else inc)",
+                    f"{_name_kind(name)} (hist.* -> observe, gauge.*/"
+                    f"fleet.* -> set_gauge, else inc)",
                 )
             )
     for name, line in sorted(registry.items()):

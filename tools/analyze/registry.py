@@ -42,6 +42,9 @@ INTERNAL_CLASSES = (
     ("bitcoin_miner_tpu/utils/metrics.py", "RateMeter"),
     ("bitcoin_miner_tpu/utils/trace.py", "Tracer"),
     ("bitcoin_miner_tpu/lspnet/chaos.py", "NetSim"),
+    ("bitcoin_miner_tpu/utils/fleetview.py", "FleetView"),
+    ("bitcoin_miner_tpu/utils/slo.py", "SloEngine"),
+    ("bitcoin_miner_tpu/utils/telemetry.py", "TelemetryHub"),
 )
 
 #: Functions whose locals carry ``# guarded-by: <lockvar>`` annotations

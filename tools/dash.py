@@ -1,0 +1,218 @@
+"""Live terminal dashboard over the fleet metrics plane (ISSUE 7).
+
+Renders the merged fleet view + SLO state the server's telemetry hub
+publishes — either from the fleet-log JSONL file (``--fleet-log=FILE`` /
+``BMT_FLEET_LOG`` on the server) or live over the telemetry sidecar
+channel itself (subscribe mode):
+
+    python -m tools.dash fleet.jsonl            # last state, one frame
+    python -m tools.dash fleet.jsonl --follow   # tail the file live
+    python -m tools.dash --connect HOST:PORT    # subscribe to the hub
+    python -m tools.dash fleet.jsonl --once     # one frame, no ANSI
+
+One frame shows: source liveness (fresh/stale with ages), the SLO table
+(burn rates fast/slow, firing state), flagged stragglers, the merged
+latency histograms (p50/p95/p99 — ``-`` when empty, never a misleading
+0), and the busiest counters.  ``--once`` renders a single frame without
+clearing the screen (scripts, tests); the default loop redraws per
+update until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Iterator, List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
+
+from bitcoin_miner_tpu.utils.metrics import format_quantiles  # noqa: E402
+
+#: Counters worth a dashboard row even when many exist (prefix order =
+#: display order); everything else folds into the "other" count.
+_COUNTER_PREFIXES = ("sched.", "gateway.", "miner.", "telemetry.", "slo.")
+
+
+def _fmt_age(age_s: float) -> str:
+    return f"{age_s:.1f}s" if age_s < 120 else f"{age_s / 60:.1f}m"
+
+
+def render_frame(state: dict, width: int = 78) -> str:
+    """One dashboard frame from a merged-state dict (the fleet-log row /
+    subscriber payload shape: FleetView.merged_state + stragglers + slo)."""
+    bar = "-" * width
+    lines: List[str] = []
+    total = state.get("sources", 0) + state.get("stale_sources", 0)
+    lines.append(
+        f"fleet: {state.get('sources', 0)}/{total} sources fresh"
+        + (f", {state['stale_sources']} stale" if state.get("stale_sources") else "")
+    )
+    per = state.get("per_source") or {}
+    for name in sorted(per):
+        info = per[name]
+        mark = "STALE" if info.get("stale") else "ok"
+        lines.append(
+            f"  {name:<24} {mark:<6} age={_fmt_age(info.get('age_s', 0.0))}"
+        )
+    slo = state.get("slo")
+    if slo:
+        lines.append(bar)
+        lines.append("SLO                     burn fast/slow   state")
+        for s in slo.get("slos", []):
+            mark = "ALERT" if s.get("firing") else "ok"
+            lines.append(
+                f"  {s['name']:<20} {s['burn_fast']:>8.2f}/{s['burn_slow']:<8.2f} {mark}"
+            )
+    strag = state.get("stragglers")
+    if strag:
+        lines.append(bar)
+        lines.append("stragglers:")
+        for s in strag:
+            lines.append(
+                f"  {s['source']:<24} p50={s['p50_s']:.3g}s "
+                f"(fleet {s['fleet_p50_s']:.3g}s, {s['ratio']:.1f}x)"
+            )
+    hists = state.get("hists") or {}
+    if hists:
+        lines.append(bar)
+        lines.append("latency (p50/p95/p99)            n")
+        for name in sorted(hists):
+            s = hists[name]
+            lines.append(
+                f"  {name:<28} {format_quantiles(s):<20} {int(s.get('count', 0))}"
+            )
+    counters = state.get("counters") or {}
+    shown = {
+        k: v for k, v in counters.items()
+        if k.startswith(_COUNTER_PREFIXES) and v
+    }
+    if shown:
+        lines.append(bar)
+        lines.append("counters:")
+        for k in sorted(shown):
+            lines.append(f"  {k:<36} {shown[k]}")
+        rest = len([k for k in counters if k not in shown])
+        if rest:
+            lines.append(f"  (+{rest} more)")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------- inputs
+
+def _states_from_file(path: str, follow: bool, poll_s: float) -> Iterator[dict]:
+    """Parsed rows from a fleet-log JSONL file.  Non-follow mode yields
+    just the LAST decodable row (the current state); follow mode starts
+    there and then tails.  Torn final lines (a concurrent append) are
+    skipped and retried on the next poll."""
+    pos = 0
+    last: Optional[dict] = None
+    while True:
+        try:
+            with open(path) as f:
+                f.seek(pos)
+                for line in f:
+                    if not line.endswith("\n"):
+                        break  # torn tail: reread from pos next poll
+                    pos += len(line)
+                    try:
+                        last = json.loads(line)
+                    except ValueError:
+                        continue
+        except FileNotFoundError as e:
+            # Follow mode races the server's FIRST publish (the hub only
+            # creates the file on its first rate-limited beat): wait for
+            # it instead of dying on a race the user cannot see.
+            if follow:
+                time.sleep(poll_s)
+                continue
+            raise SystemExit(f"cannot read {path}: {e}")
+        except OSError as e:
+            raise SystemExit(f"cannot read {path}: {e}")
+        if last is not None:
+            yield last
+            last = None
+        if not follow:
+            return
+        time.sleep(poll_s)
+
+
+def _states_from_hub(hostport: str) -> Iterator[dict]:
+    """Subscribe to a live hub over the telemetry sidecar channel and
+    yield merged states as they are published."""
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.utils.telemetry import (
+        FrameAssembler,
+        encode_subscribe,
+    )
+
+    host, _, port = hostport.rpartition(":")
+    try:
+        client = lsp.Client(host or "127.0.0.1", int(port))
+    except (lsp.LspError, OSError, ValueError) as e:
+        raise SystemExit(f"cannot connect to telemetry hub {hostport}: {e}")
+    asm = FrameAssembler()
+    try:
+        client.write(encode_subscribe())
+        while True:
+            try:
+                payload = client.read()
+            except lsp.LspError:
+                return  # hub gone: end of stream
+            done, obj = asm.feed(payload)
+            if done and isinstance(obj, dict):
+                yield obj
+    finally:
+        try:
+            client.close()
+        except lsp.LspError:
+            pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dash", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("file", nargs="?", default=None,
+                    help="fleet-log JSONL file (server --fleet-log=FILE)")
+    ap.add_argument("--connect", metavar="HOST:PORT", default=None,
+                    help="subscribe to a live server's --telemetry-port")
+    ap.add_argument("--follow", action="store_true",
+                    help="keep tailing the file (connect mode always follows)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="file poll interval in follow mode (seconds)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame without ANSI clears and exit")
+    args = ap.parse_args(argv)
+    if (args.file is None) == (args.connect is None):
+        ap.error("give a fleet-log FILE or --connect HOST:PORT (not both)")
+
+    states = (
+        _states_from_hub(args.connect)
+        if args.connect
+        else _states_from_file(args.file, args.follow and not args.once,
+                               args.interval)
+    )
+    saw = False
+    try:
+        for state in states:
+            frame = render_frame(state)
+            if args.once:
+                print(frame)
+                return 0
+            # Clear + home, then the frame — a live dashboard, not a log.
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            saw = True
+    except KeyboardInterrupt:
+        return 0
+    if not saw:
+        print("no fleet states found", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

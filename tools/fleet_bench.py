@@ -71,6 +71,24 @@ def log(*a) -> None:
     print(*a, file=sys.stderr, flush=True)
 
 
+def _read_last_fleet_state(path: str) -> dict:
+    """Last decodable row of the server's fleet log (torn tails from a
+    concurrent append are skipped)."""
+    last = None
+    try:
+        with open(path) as f:
+            for line in f:
+                if not line.endswith("\n"):
+                    break
+                try:
+                    last = json.loads(line)
+                except ValueError:
+                    continue
+    except OSError:
+        return None
+    return last
+
+
 def _wait_listening(proc: subprocess.Popen, timeout: float) -> None:
     import select
 
@@ -91,27 +109,40 @@ def _wait_listening(proc: subprocess.Popen, timeout: float) -> None:
 
 class MinerKeeper:
     """Owns the miner subprocess: spawns it, watches its chunk-timing log
-    for liveness, kills + respawns on wedge/death."""
+    for liveness, kills + respawns on wedge/death.  ``telemetry`` is the
+    server's sidecar hostport (ISSUE 7): a respawned miner re-arms its
+    exporter too, so the fleet view keeps seeing the replacement."""
 
-    def __init__(self, port: int, backend: str, log_path: str) -> None:
+    def __init__(
+        self, port: int, backend: str, log_path: str,
+        telemetry: str = None,
+    ) -> None:
         self.port = port
         self.backend = backend
         self.log_path = log_path
+        self.telemetry = telemetry
         self.restarts = 0
         self.proc: subprocess.Popen = None
         self.spawn()
 
     def spawn(self) -> None:
         self._log_f = open(self.log_path, "ab", buffering=0)
+        argv = [
+            sys.executable,
+            "-m",
+            "bitcoin_miner_tpu.apps.miner",
+            f"127.0.0.1:{self.port}",
+            "--backend",
+            self.backend,
+        ]
+        if self.telemetry:
+            argv += [
+                "--telemetry", self.telemetry,
+                "--telemetry-interval", "1.0",
+                "--source", "tpu-miner",
+            ]
         self.proc = subprocess.Popen(
-            [
-                sys.executable,
-                "-m",
-                "bitcoin_miner_tpu.apps.miner",
-                f"127.0.0.1:{self.port}",
-                "--backend",
-                self.backend,
-            ],
+            argv,
             cwd=str(REPO),
             env={**os.environ, "BMT_MINER_LOG": "1"},
             stdout=subprocess.DEVNULL,
@@ -283,6 +314,15 @@ def main() -> int:
         help="arm the server's structured event log (BMT_TRACE) and write "
         "it here; analyze with python -m tools.trace",
     )
+    ap.add_argument(
+        "--telemetry",
+        action="store_true",
+        help="arm the fleet metrics plane (ISSUE 7): the server opens a "
+        "telemetry sidecar port + SLO engine, every miner exports "
+        "snapshots to it, and the fleet-merged histograms + SLO verdicts "
+        "are stamped into the JSON line (watch live: python -m tools.dash "
+        "--connect)",
+    )
     args = ap.parse_args()
 
     port = args.port or 3000 + (os.getpid() * 7919) % 50000
@@ -295,6 +335,21 @@ def main() -> int:
     cpu_miners: list = []
     try:
         server_env = {**os.environ, "PYTHONPATH": str(REPO)}
+        tele_addr = None
+        fleet_log = None
+        if args.telemetry:
+            # The sidecar port rides next to the serving port; the server
+            # appends the merged view to a fleet log this tool reads back
+            # for the JSON stamp (and tools.dash can tail live).
+            tport = port + 1
+            tele_addr = f"127.0.0.1:{tport}"
+            fleet_log = os.path.join(tmp, "fleet.jsonl")
+            server_env.update(
+                BMT_TELEMETRY_PORT=str(tport),
+                BMT_FLEET_LOG=fleet_log,
+                BMT_SLO="1",
+            )
+            log(f"telemetry: sidecar on :{tport}, fleet log -> {fleet_log}")
         if args.trace:
             # The server process owns the gateway/scheduler events; its
             # ticker drains them to the file (apps/server.main reads
@@ -332,19 +387,26 @@ def main() -> int:
         )
         _wait_listening(server, 30)
         log(f"server up on :{port}; miner log -> {miner_log}")
-        keeper = MinerKeeper(port, args.backend, miner_log)
+        keeper = MinerKeeper(port, args.backend, miner_log, telemetry=tele_addr)
         for i in range(args.cpu_miners):
             cpu_log = open(os.path.join(tmp, f"cpu_miner_{i}.log"), "wb")
+            cpu_argv = [
+                sys.executable,
+                "-m",
+                "bitcoin_miner_tpu.apps.miner",
+                f"127.0.0.1:{port}",
+                "--backend",
+                "cpu",
+            ]
+            if tele_addr:
+                cpu_argv += [
+                    "--telemetry", tele_addr,
+                    "--telemetry-interval", "1.0",
+                    "--source", f"cpu-miner-{i}",
+                ]
             cpu_miners.append(
                 subprocess.Popen(
-                    [
-                        sys.executable,
-                        "-m",
-                        "bitcoin_miner_tpu.apps.miner",
-                        f"127.0.0.1:{port}",
-                        "--backend",
-                        "cpu",
-                    ],
+                    cpu_argv,
                     cwd=str(REPO),
                     stdout=subprocess.DEVNULL,
                     stderr=cpu_log,
@@ -447,6 +509,11 @@ def main() -> int:
             log(f"kill drill: match={match} ({clean} vs {killed})")
             if not match:
                 raise RuntimeError(f"kill drill mismatch: {clean} vs {killed}")
+        # Fleet-plane stamp (ISSUE 7): the merged view + SLO verdicts the
+        # server's hub last published, read back while it is still up.
+        fleet_stamp = _read_last_fleet_state(fleet_log) if fleet_log else None
+        if args.telemetry and fleet_stamp is None:
+            log("warning: --telemetry armed but no fleet state was published")
         print(
             json.dumps(
                 {
@@ -487,6 +554,31 @@ def main() -> int:
                         else {}
                     ),
                     **({"kill_drill": drill} if drill is not None else {}),
+                    **(
+                        {
+                            "fleet": {
+                                "sources": fleet_stamp["sources"],
+                                "stale_sources": fleet_stamp["stale_sources"],
+                                "hists": fleet_stamp["hists"],
+                                "stragglers": [
+                                    s["source"]
+                                    for s in fleet_stamp.get("stragglers", [])
+                                ],
+                            },
+                            "slo": {
+                                s["name"]: {
+                                    "ok": s["ok"],
+                                    "burn_fast": s["burn_fast"],
+                                    "burn_slow": s["burn_slow"],
+                                }
+                                for s in fleet_stamp.get("slo", {}).get(
+                                    "slos", []
+                                )
+                            },
+                        }
+                        if fleet_stamp is not None
+                        else {}
+                    ),
                 }
             ),
             flush=True,
