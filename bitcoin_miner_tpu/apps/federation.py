@@ -1,0 +1,134 @@
+"""The federation replica binary: one scheduler cell of a replicated tier.
+
+Beyond-parity entrypoint (ISSUE 8; the frozen server/client/miner CLI
+contracts are untouched).  Each replica serves the frozen client/miner
+protocol on ``<port>``, peer traffic (forwarded requests + span gossip)
+on ``--fed-port``, and routes by consistent-hashing the request's
+``data`` across ``--peers``.  A two-replica fleet on one machine:
+
+    python -m bitcoin_miner_tpu.apps.federation 5001 --cell=r1 \
+        --fed-port=6001 --peers=r2=127.0.0.1:6002
+    python -m bitcoin_miner_tpu.apps.federation 5002 --cell=r2 \
+        --fed-port=6002 --peers=r1=127.0.0.1:6001
+
+then point miners and clients at EITHER port — duplicates collapse on
+the home replica, spans gossip both ways, and killing one replica leaves
+the other serving every data key (failover + local fallback).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..federation import GossipSpanStore, Replica
+from ..gateway import ResultCache
+
+
+def parse_peers(spec: str) -> Dict[str, Tuple[str, int]]:
+    """``name=host:port[,name=host:port...]`` -> peer map."""
+    peers: Dict[str, Tuple[str, int]] = {}
+    for part in spec.split(","):
+        if not part:
+            continue
+        name, sep, hostport = part.partition("=")
+        host, hsep, port = hostport.rpartition(":")
+        if not sep or not name or not hsep:
+            raise ValueError(f"peer {part!r} is not name=host:port")
+        peers[name] = (host or "127.0.0.1", int(port))
+    return peers
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv if argv is None else argv
+    cell = os.environ.get("BMT_CELL") or "r1"
+    fed_port = 0
+    peers_spec = os.environ.get("BMT_PEERS") or ""
+    checkpoint_path = None
+    cache_path = None
+    spans_path = None
+    trace_path = os.environ.get("BMT_TRACE") or None
+    rate: Optional[float] = None
+    gossip_interval = 1.0
+    pos = []
+    for a in argv[1:]:
+        if a.startswith("--cell="):
+            cell = a.split("=", 1)[1]
+        elif a.startswith("--fed-port="):
+            fed_port = int(a.split("=", 1)[1])
+        elif a.startswith("--peers="):
+            peers_spec = a.split("=", 1)[1]
+        elif a.startswith("--checkpoint="):
+            checkpoint_path = a.split("=", 1)[1]
+        elif a.startswith("--cache="):
+            cache_path = a.split("=", 1)[1]
+        elif a.startswith("--spans="):
+            spans_path = a.split("=", 1)[1]
+        elif a.startswith("--trace="):
+            trace_path = a.split("=", 1)[1]
+        elif a.startswith("--rate="):
+            rate = float(a.split("=", 1)[1]) or None
+        elif a.startswith("--gossip-interval="):
+            gossip_interval = float(a.split("=", 1)[1])
+        else:
+            pos.append(a)
+    if len(pos) != 1:
+        print(
+            f"Usage: ./{argv[0]} <port> --cell=NAME [--fed-port=P] "
+            "[--peers=name=host:port,...]",
+            end="",
+        )
+        return 0
+    try:
+        port = int(pos[0])
+        peers = parse_peers(peers_spec)
+    except ValueError as e:
+        print("Bad argument:", e)
+        return 0
+    # One log file per cell — two replicas in one cwd must not interleave.
+    logging.basicConfig(
+        filename=f"log.{cell}.txt",
+        level=logging.INFO,
+        format="%(asctime)s %(filename)s:%(lineno)d %(message)s",
+    )
+    if trace_path:
+        from ..utils.trace import TRACE
+
+        TRACE.enable(path=trace_path)
+    try:
+        replica = Replica(
+            cell,
+            peers,
+            port=port,
+            fed_port=fed_port,
+            cache=ResultCache(path=cache_path),
+            spans=GossipSpanStore(path=spans_path),
+            rate=rate,
+            gossip_interval=gossip_interval,
+            checkpoint_path=checkpoint_path,
+            tick_interval=1.0,
+        )
+    except OSError as e:
+        print(str(e))
+        return 0
+    replica.start()
+    print(
+        f"Replica {cell} listening on port {replica.port} "
+        f"(federation port {replica.fed_port})",
+        flush=True,
+    )
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        replica.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,7 @@ def serve(
     checkpoint_path: Optional[str] = None,
     health_interval: float = 10.0,
     telemetry=None,
+    lock=None,
 ) -> None:
     """Run the scheduler loop over an already-listening LSP server until the
     server is closed.  Factored out of main() so tests drive it in-process.
@@ -61,11 +62,17 @@ def serve(
     burn-rate evaluation, straggler detection, publish sinks — OFF the
     event lock (the hub carries its own locks), so a full fleet-log disk
     or a dead dashboard can never stall the serve loop.
+
+    ``lock`` lets a caller that shares the engine with threads of its
+    own (the federation replica's ingest/forwarder/gossip threads,
+    ISSUE 8) supply the event lock those threads already hold their
+    accesses under; default is a private lock, exactly as before.
     """
     log = log or logging.getLogger("bitcoin_miner_tpu.server")
     # Serializes scheduler access with the ticker (tracked under
     # BMT_SANITIZE=1, a plain threading.Lock otherwise).
-    lock = sanitize.make_lock("serve.event")
+    if lock is None:
+        lock = sanitize.make_lock("serve.event")
     sched = scheduler if scheduler is not None else Scheduler()  # guarded-by: lock
     # A gateway-wrapped scheduler carries a result cache; its disk flushes
     # ride this ticker (snapshot under the lock, write outside) just like

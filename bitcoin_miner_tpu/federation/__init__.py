@@ -1,0 +1,42 @@
+"""federation — the replicated serving tier (ISSUE 8).
+
+One scheduler process owning the whole fleet is both a single point of
+failure and a single-process throughput ceiling.  This package is the
+layer above everything built so far: N gateway **replicas** in front of
+M scheduler cells, where
+
+- :class:`~bitcoin_miner_tpu.federation.ring.Ring` consistent-hashes the
+  job signature's ``data`` onto a home replica, so overlapping sub-ranges
+  of the same data land on the same cell and the existing coalescing,
+  exact-match cache and interval-store planning keep collapsing
+  duplicates;
+- :class:`~bitcoin_miner_tpu.federation.replica.Replica` is one cell's
+  shell: the public serving port (clients + miners), the federation port
+  (peer-forwarded requests + span gossip, always served locally — which
+  is what makes forwarding loop-free), the router that forwards non-home
+  requests and fails over to the next replica on the ring when the home
+  is dead;
+- :class:`~bitcoin_miner_tpu.federation.gossip.SpanGossip` periodically
+  exchanges solved-span deltas and full-state syncs between replicas
+  over LSP, framed with the telemetry fragmentation machinery
+  (zlib + ``T1|id|i|n|chunk``) so every datagram respects the frozen
+  1000-byte wire ceiling — a range solved anywhere answers everywhere,
+  bit-exact under the interval store's argmin-inside-query rule.
+
+``python -m bitcoin_miner_tpu.apps.federation`` runs one replica;
+``tools/loadgen.py --federation N`` benches a whole federation in
+process (BENCH_pr8.json).
+"""
+
+from .gossip import GossipSpanStore, SpanGossip, decode_gossip, encode_gossip
+from .replica import Replica
+from .ring import Ring
+
+__all__ = [
+    "GossipSpanStore",
+    "Replica",
+    "Ring",
+    "SpanGossip",
+    "decode_gossip",
+    "encode_gossip",
+]

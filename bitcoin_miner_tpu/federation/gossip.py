@@ -1,0 +1,254 @@
+"""Span-store gossip between replicas (ISSUE 8).
+
+A range solved anywhere should answer everywhere.  Each replica
+journals the spans IT solved (:class:`GossipSpanStore`) and a daemon
+(:class:`SpanGossip`) periodically ships them to every peer's federation
+port: **delta** beats carry the journal drained since the last beat,
+and every ``full_every``-th beat carries the **full** span state instead
+— the anti-entropy pass that makes a replica whose gossip link was
+partitioned (or whose deltas were lost with a dead conn) converge again
+once the partition lifts.
+
+Wire format: the telemetry fragmentation machinery
+(:func:`~bitcoin_miner_tpu.utils.telemetry.encode_frames` — compact JSON
++ zlib, split into ``T1|id|i|n|chunk`` fragments) so every datagram
+respects the frozen 1000-byte LSP wire ceiling however many spans a full
+sync carries.  Gossip rides reliable LSP conns labeled
+``gossip-<cell>``, so the chaos layer can partition or throttle one
+replica's gossip channel without touching its serving or forwarding
+links.
+
+Merging a peer's span is sound anywhere: a span ``[lo, hi] ->
+(min_hash, nonce)`` is a fact about a pure function, and the interval
+store's argmin-inside-query answerability rule keeps every answer built
+from it bit-exact — gossip changes WHERE a fact is known, never what it
+says.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from .. import lsp
+from ..gateway.cache import SpanStore
+from ..utils.metrics import METRICS
+from ..utils.telemetry import encode_frames
+
+GOSSIP_V = 1
+
+#: (data, lo, hi, min_hash, nonce) — one solved span on the wire.
+WireSpan = Tuple[str, int, int, int, int]
+
+
+def encode_gossip(
+    cell: str, seq: int, spans: List[WireSpan], full: bool
+) -> List[bytes]:
+    """One gossip message as ready-to-write LSP payloads (every frame's
+    datagram stays under the frozen wire ceiling)."""
+    return encode_frames(
+        {
+            "v": GOSSIP_V,
+            "kind": "spans",
+            "from": cell,
+            "seq": seq,
+            "full": bool(full),
+            "spans": [list(s) for s in spans],
+        },
+        seq,
+    )
+
+
+def decode_gossip(obj: Optional[dict]) -> Optional[dict]:
+    """Version/shape gate on an assembled gossip message; None for
+    anything alien (best-effort channel: drop, count, carry on)."""
+    if not isinstance(obj, dict) or obj.get("v") != GOSSIP_V:
+        return None
+    if obj.get("kind") != "spans" or not isinstance(obj.get("from"), str):
+        return None
+    if not isinstance(obj.get("spans"), list):
+        return None
+    return obj
+
+
+def apply_gossip(store: SpanStore, msg: dict) -> int:
+    """Fold a decoded gossip message into ``store``; returns the rows
+    that passed the gate (a len() delta would undercount — merges
+    coalesce).  Caller serializes (the replica's event lock).  Row
+    validation mirrors the span-store's disk loader: one bad row must
+    not poison the rest."""
+    merged = 0
+    for row in msg["spans"]:
+        try:
+            data, lo, hi, h, n = row
+        except (TypeError, ValueError):
+            continue
+        if not isinstance(data, str) or not all(
+            isinstance(v, int) and not isinstance(v, bool)
+            for v in (lo, hi, h, n)
+        ):
+            continue
+        store.add_remote(data, lo, hi, h, n)
+        merged += 1
+    return merged
+
+
+class GossipSpanStore(SpanStore):
+    """A :class:`SpanStore` that journals locally-solved spans for the
+    gossip daemon.  ``add`` (the gateway's path for chunks this cell
+    swept, and the disk loader's — a restart's reloaded spans are state
+    peers may lack) journals; ``add_remote`` (gossip ingest) does not,
+    so full-mesh gossip never echoes a peer's spans back at it.
+
+    The journal is bounded: overflow drops oldest — a lost delta only
+    delays convergence until the next full sync, never correctness.
+    Not thread-safe by itself — serialized under the replica's event
+    lock like every other policy structure."""
+
+    def __init__(
+        self,
+        capacity: int = 512,
+        max_spans_per_data: int = 64,
+        path: Optional[str] = None,
+        journal_max: int = 4096,
+    ) -> None:
+        self.journal_max = max(1, int(journal_max))
+        self._journal: Deque[WireSpan] = deque(maxlen=self.journal_max)
+        super().__init__(capacity, max_spans_per_data, path)
+
+    def add(self, data: str, lo: int, hi: int, hash_: int, nonce: int) -> None:
+        if self.capacity == 0 or lo > hi or not (lo <= nonce <= hi):
+            return  # mirror the store's refusal: refused spans don't gossip
+        super().add(data, lo, hi, hash_, nonce)
+        self._journal.append((data, lo, hi, hash_, nonce))
+
+    def add_remote(
+        self, data: str, lo: int, hi: int, hash_: int, nonce: int
+    ) -> None:
+        """A peer's span: merged, never re-journaled."""
+        super().add(data, lo, hi, hash_, nonce)
+
+    def drain_journal(self) -> List[WireSpan]:
+        out = list(self._journal)
+        self._journal.clear()
+        return out
+
+    def export_spans(self) -> List[WireSpan]:
+        """Every solved span (the full-sync payload)."""
+        return [
+            (data, s[0], s[1], s[2], s[3])
+            for data, m in self._maps.items()
+            for s in m.spans()
+        ]
+
+
+class SpanGossip:
+    """The per-replica gossip daemon: one timer thread shipping span
+    deltas/full syncs to every peer's federation port.  Store access is
+    serialized under the replica's event lock (held only for the
+    snapshot — sends happen outside it); conn state lives on the gossip
+    thread alone."""
+
+    def __init__(
+        self,
+        cell: str,
+        store: GossipSpanStore,
+        peers: Dict[str, Tuple[str, int]],
+        lock,
+        interval: float = 1.0,
+        full_every: int = 4,
+        params: Optional["lsp.Params"] = None,
+    ) -> None:
+        if interval <= 0:
+            raise ValueError(f"interval must be positive, got {interval}")
+        self.cell = cell
+        self.store = store
+        self.peers = dict(peers)
+        self.lock = lock
+        self.interval = interval
+        self.full_every = max(1, int(full_every))
+        self.params = params
+        #: Largest gossip datagram written so far (the wire-ceiling
+        #: acceptance surface — benches and tests assert it stays under
+        #: the frozen 1000-byte limit with envelope headroom).
+        self.max_frame_bytes = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._clients: Dict[str, "lsp.Client"] = {}  # gossip thread only
+        self._seq = 0  # gossip thread only
+        self._beat = 0  # gossip thread only
+
+    def start(self) -> "SpanGossip":
+        self._thread = threading.Thread(
+            target=self._loop, name=f"gossip-{self.cell}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        for c in self._clients.values():
+            try:
+                c.close()
+            except lsp.LspError:
+                pass
+        self._clients.clear()
+
+    # ------------------------------------------------------------- internals
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.beat()
+            except Exception:
+                METRICS.inc("federation.gossip_errors")
+
+    def beat(self) -> None:
+        """One gossip round (public so tests and benches can drive beats
+        deterministically instead of sleeping)."""
+        if not self.peers:
+            return
+        self._beat += 1
+        full = self._beat % self.full_every == 0
+        with self.lock:
+            delta = self.store.drain_journal()
+            spans = self.store.export_spans() if full else delta
+        if not spans and not full:
+            return  # nothing new: stay quiet between full syncs
+        self._seq += 1
+        frames = encode_gossip(self.cell, self._seq, spans, full)
+        for f in frames:
+            if len(f) > self.max_frame_bytes:
+                self.max_frame_bytes = len(f)
+        for name in sorted(self.peers):
+            if self._send(name, frames):
+                METRICS.inc("federation.gossip_beats")
+                METRICS.inc("federation.gossip_frames", len(frames))
+            else:
+                METRICS.inc("federation.gossip_errors")
+
+    def _send(self, name: str, frames: List[bytes]) -> bool:
+        client = self._clients.get(name)
+        if client is None:
+            host, port = self.peers[name]
+            try:
+                client = lsp.Client(
+                    host, port, self.params, label=f"gossip-{self.cell}"
+                )
+            except (lsp.LspError, OSError):
+                return False
+            self._clients[name] = client
+        try:
+            for f in frames:
+                client.write(f)
+            return True
+        except lsp.LspError:
+            try:
+                client.close()
+            except lsp.LspError:
+                pass
+            self._clients.pop(name, None)
+            return False

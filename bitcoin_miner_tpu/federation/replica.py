@@ -1,0 +1,549 @@
+"""One federation cell: public serving port + federation port + router.
+
+A :class:`Replica` owns a whole scheduler cell — gateway (coalescing,
+exact cache, interval store, admission), scheduler, miners' serving port
+— plus the federation machinery that makes N such cells one service:
+
+- The **public port** speaks the frozen client/miner protocol through
+  the existing :func:`~bitcoin_miner_tpu.apps.server.serve` loop; the
+  engine it drives is this replica's :class:`_Router`.
+- The **router** consistent-hashes each Request's ``data`` on the ring.
+  Home requests flow into the local gateway unchanged.  Non-home
+  requests are handed to a forwarder pool that relays them to the home
+  replica's *federation port* and fans the Result back; a dead home
+  fails over to the next replica on the ring, and when every peer is
+  unreachable the request is served locally (correct everywhere beats
+  routed nowhere).
+- The **federation port** receives peer traffic: forwarded Requests
+  (always served LOCALLY — a request arriving here never re-forwards,
+  which is what makes routing loop-free even when ring views disagree
+  mid-failover) and ``T1``-framed span gossip.  Its conns are mapped
+  into the engine under ``FED_BASE + conn_id`` so one gateway serves
+  both ports without id collisions.
+
+Locking: ONE event lock serializes the gateway/scheduler across the
+serve loop, the federation ingest thread, the forwarder pool and the
+gossip daemon — the same discipline (and the same
+``BMT_SANITIZE=1``-trackable lock) as a standalone server.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .. import lsp
+from ..apps import server as server_mod
+from ..apps.client import request_once
+from ..apps.scheduler import Action, Scheduler
+from ..bitcoin.message import Message, MsgType
+from ..gateway import Gateway, ResultCache
+from ..utils import sanitize
+from ..utils import trace as _trace
+from ..utils.metrics import METRICS
+from ..utils.telemetry import FrameAssembler
+from .gossip import GossipSpanStore, SpanGossip, apply_gossip, decode_gossip
+from .ring import Ring
+
+#: Federation-port conns are offset into this id space before they meet
+#: the engine: public LSP conn ids and federation LSP conn ids are two
+#: independent counters, and the scheduler/gateway key everything on the
+#: conn id.  Gateway virtual ids are negative, real conns small positive
+#: ints — 2**40 is unreachable by either.
+FED_BASE = 1 << 40
+
+#: One forward task: (public conn, data, lower, upper, request time).
+_Forward = Tuple[int, str, int, int, float]
+
+
+class _Router:
+    """The engine ``serve`` drives: the local gateway, plus routing.
+    Speaks the scheduler's exact event interface; every method is called
+    under the replica's event lock (by serve, the federation ingest, or
+    a forwarder's fallback path)."""
+
+    def __init__(self, replica: "Replica") -> None:
+        self._r = replica
+        self.gw = replica.gateway
+
+    # ------------------------------------------------------------------ events
+
+    def miner_joined(self, conn_id: int, now: float = 0.0) -> List[Action]:
+        if conn_id in self._r._fwd_conns:
+            # Request-then-Join role confusion on a conn whose Request is
+            # being forwarded: the gateway's own guard cannot see it (no
+            # gateway state exists for a forwarded conn), so refuse here
+            # — same contract as Gateway.miner_joined's guard.
+            return []
+        return self._split(self.gw.miner_joined(conn_id, now))
+
+    def client_request(
+        self,
+        conn_id: int,
+        data: str,
+        lower: int,
+        upper: int,
+        now: float = 0.0,
+        client_key: Optional[str] = None,
+    ) -> List[Action]:
+        r = self._r
+        if conn_id in r._fwd_conns:
+            return []  # one job per conn, forwarded or not
+        if r.peers and lower <= upper and 0 <= lower and upper < 1 << 64:
+            home = r.ring.home(data)
+            if home != r.cell:
+                # Answer from LOCAL state first: forwarded Results are
+                # exact-cached here and gossip fills the span store, so a
+                # repeat (or a sub-range gossip already covers) costs no
+                # peer round trip — the home cell never hears about it.
+                ans = self.gw.answer_local(conn_id, data, lower, upper)
+                if ans is not None:
+                    METRICS.inc("federation.local_answers")
+                    return [ans]
+                # Not ours: relay to the home replica off the event loop
+                # (the forwarder blocks on the peer's Result).  Empty and
+                # poison ranges stay local — trivially answerable, and the
+                # gateway's guards must see poison before any state forms.
+                # The relay queue is BOUNDED: when the forwarder pool is
+                # drowning, serving locally through normal admission
+                # (queue/shed) beats buffering requests without limit.
+                try:
+                    r._fwd_q.put_nowait((conn_id, data, lower, upper, now))
+                except queue.Full:
+                    METRICS.inc("federation.local_fallbacks")
+                else:
+                    r._fwd_conns.add(conn_id)
+                    METRICS.inc("federation.forwarded")
+                    _trace.emit(
+                        None, "fed", "forward",
+                        cell=r.cell, home=home, data=data[:64],
+                        lower=lower, upper=upper,
+                    )
+                    return []
+        return self._split(
+            self.gw.client_request(
+                conn_id, data, lower, upper, now, client_key=client_key
+            )
+        )
+
+    def result(
+        self, conn_id: int, hash_: int, nonce: int, now: float = 0.0
+    ) -> List[Action]:
+        return self._split(self.gw.result(conn_id, hash_, nonce, now))
+
+    def lost(self, conn_id: int, now: float = 0.0) -> List[Action]:
+        # A dead forwarded conn has no gateway state to clean; the
+        # forwarder's eventual Result write just fails harmlessly.
+        self._r._fwd_conns.discard(conn_id)
+        return self._split(self.gw.lost(conn_id, now))
+
+    def tick(self, now: float) -> List[Action]:
+        return self._split(self.gw.tick(now))
+
+    # ------------------------------------------------------------ pass-through
+
+    @property
+    def revision(self) -> int:
+        return self.gw.revision
+
+    @property
+    def cache(self) -> ResultCache:
+        return self.gw.cache
+
+    @property
+    def spans(self) -> GossipSpanStore:
+        return self._r.spans
+
+    def checkpoint(self) -> dict:
+        return self.gw.checkpoint()
+
+    def load_checkpoint(self, state: dict) -> None:
+        self.gw.load_checkpoint(state)
+
+    def vt_floor(self) -> float:
+        return self.gw.vt_floor()
+
+    def queue_vt_floor(self) -> float:
+        return self.gw.queue_vt_floor()
+
+    def stats(self) -> Dict[str, int]:
+        st = self.gw.stats()
+        st.update(fed_peers=len(self._r.peers), fed_queue=self._r._fwd_q.qsize())
+        return st
+
+    def drain_evictions(self) -> List[int]:
+        """Public evictions are returned for the serve shell to close;
+        federation-port evictions (a shed forwarded request) are closed
+        here on the federation server."""
+        out: List[int] = []
+        for cid in self.gw.drain_evictions():
+            if cid >= FED_BASE:
+                self._r._close_fed(cid - FED_BASE)
+            else:
+                out.append(cid)
+        return out
+
+    # ------------------------------------------------------------------ helpers
+
+    def _split(self, actions: List[Action]) -> List[Action]:
+        """Deliver federation-port actions (Results for forwarded
+        requests) on the federation server; return the rest (miner chunk
+        Requests, local client Results) for the caller's transport."""
+        out: List[Action] = []
+        for cid, msg in actions:
+            if cid >= FED_BASE:
+                self._r._write_fed(cid - FED_BASE, msg)
+            else:
+                out.append((cid, msg))
+        return out
+
+
+class Replica:
+    """One federation cell (see module docstring).  ``peers`` maps the
+    OTHER replicas' names to their federation ``(host, port)``; every
+    replica must be configured with the same name set or ring views
+    diverge (routing stays correct — the federation port serves locally
+    — but duplicates stop collapsing)."""
+
+    def __init__(
+        self,
+        cell: str,
+        peers: Optional[Dict[str, Tuple[str, int]]] = None,
+        *,
+        port: int = 0,
+        fed_port: int = 0,
+        host: str = "127.0.0.1",
+        params: Optional["lsp.Params"] = None,
+        scheduler: Optional[Scheduler] = None,
+        cache: Optional[ResultCache] = None,
+        spans: Optional[GossipSpanStore] = None,
+        rate: Optional[float] = None,
+        max_queued: int = 256,
+        gossip_interval: float = 1.0,
+        gossip_full_every: int = 4,
+        forward_workers: int = 4,
+        peer_down_ttl: float = 2.0,
+        tick_interval: float = 0.25,
+        checkpoint_path: Optional[str] = None,
+        telemetry=None,
+        clock=time.monotonic,
+        log: Optional[logging.Logger] = None,
+    ) -> None:
+        self.cell = cell
+        self.peers: Dict[str, Tuple[str, int]] = dict(peers or {})
+        if cell in self.peers:
+            raise ValueError(f"peers must not include the cell itself ({cell!r})")
+        self.ring = Ring([cell, *self.peers])
+        self.params = params
+        self._clock = clock
+        self._log = log or logging.getLogger("bitcoin_miner_tpu.federation")
+        # Chaos identities: the public port is the cell name (partition a
+        # whole cell), the federation port fed-<cell> (cut peer traffic),
+        # gossip clients gossip-<cell>, forward clients fwd-<cell>.
+        self.public = lsp.Server(port, params, host=host, label=cell)
+        self.fed = lsp.Server(fed_port, params, host=host, label=f"fed-{cell}")
+        self.spans = spans if spans is not None else GossipSpanStore()
+        self.gateway = Gateway(
+            scheduler if scheduler is not None else Scheduler(),
+            cache=cache if cache is not None else ResultCache(),
+            spans=self.spans,
+            rate=rate,
+            max_queued=max_queued,
+        )
+        self.lock = sanitize.make_lock(f"fed.{cell}.event")
+        self.router = _Router(self)
+        self.gossip = SpanGossip(
+            cell, self.spans, self.peers, self.lock,
+            interval=gossip_interval, full_every=gossip_full_every,
+            params=params,
+        )
+        self._tick_interval = tick_interval
+        self._checkpoint_path = checkpoint_path
+        self._telemetry = telemetry
+        self._forward_workers = max(1, int(forward_workers))
+        self._peer_down_ttl = peer_down_ttl
+        # Bounded relay backlog (overflow serves locally through normal
+        # admission); conns with a forward in flight, so the router can
+        # enforce one-job-per-conn and refuse role confusion for conns
+        # the gateway has no state for.
+        self._fwd_q: "queue.Queue[Optional[_Forward]]" = queue.Queue(
+            maxsize=4 * max_queued if max_queued > 0 else 1024
+        )
+        self._fwd_conns: set = set()  # guarded-by: lock
+        self._down_lock = threading.Lock()
+        self._down: Dict[str, float] = {}  # guarded-by: _down_lock
+        self._threads: List[threading.Thread] = []
+        self._started = False
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> "Replica":
+        """Spawn the serve loop, federation ingest, gossip daemon and
+        forwarder pool as daemon threads; returns self."""
+        self._started = True
+        t = threading.Thread(
+            target=server_mod.serve,
+            args=(self.public, self.router),
+            kwargs=dict(
+                lock=self.lock,
+                tick_interval=self._tick_interval,
+                checkpoint_path=self._checkpoint_path,
+                telemetry=self._telemetry,
+                log=self._log,
+                clock=self._clock,
+            ),
+            name=f"fed-serve-{self.cell}",
+            daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
+        ti = threading.Thread(
+            target=self._fed_ingest, name=f"fed-ingest-{self.cell}", daemon=True
+        )
+        ti.start()
+        self._threads.append(ti)
+        for i in range(self._forward_workers):
+            tw = threading.Thread(
+                target=self._forward_loop,
+                name=f"fed-fwd-{self.cell}-{i}",
+                daemon=True,
+            )
+            tw.start()
+            self._threads.append(tw)
+        if self.peers:
+            self.gossip.start()
+        return self
+
+    def close(self) -> None:
+        """Tear the cell down: closing the servers unblocks the serve
+        and ingest loops; sentinels drain the forwarder pool.  The queue
+        is bounded, so sentinel delivery must never block: shutdown beats
+        backlog — drop queued forwards to make room (their conns die with
+        the public server below)."""
+        for _ in range(self._forward_workers):
+            while True:
+                try:
+                    self._fwd_q.put_nowait(None)
+                    break
+                except queue.Full:
+                    try:
+                        self._fwd_q.get_nowait()
+                    except queue.Empty:
+                        continue
+        self.gossip.stop()
+        try:
+            self.public.close()
+        except lsp.LspError:
+            pass
+        try:
+            self.fed.close()
+        except lsp.LspError:
+            pass
+        for t in self._threads:
+            t.join(timeout=3.0)
+        self._threads = []
+
+    @property
+    def port(self) -> int:
+        return self.public.port
+
+    @property
+    def fed_port(self) -> int:
+        return self.fed.port
+
+    # ------------------------------------------------------------- transport
+
+    def _emit_public(self, actions: List[Action]) -> None:
+        for cid, msg in actions:
+            try:
+                self.public.write(cid, msg.marshal())
+            except lsp.LspError:
+                self._log.info("public write to %d failed (conn dead)", cid)
+
+    def _write_fed(self, conn_id: int, msg: Message) -> None:
+        try:
+            self.fed.write(conn_id, msg.marshal())
+        except lsp.LspError:
+            self._log.info("fed write to %d failed (conn dead)", conn_id)
+
+    def _close_fed(self, conn_id: int) -> None:
+        try:
+            self.fed.close_conn(conn_id)
+        except lsp.LspError:
+            pass
+
+    # ------------------------------------------------------- federation port
+
+    def _fed_ingest(self) -> None:
+        """Read loop for the federation port: peer-forwarded Requests
+        (served locally under the shared event lock) and framed span
+        gossip.  Frame reassembly is per-conn and this-thread-only."""
+        assemblers: Dict[int, FrameAssembler] = {}
+        while True:
+            try:
+                conn_id, payload = self.fed.read()
+            except lsp.ConnLostError as e:
+                assemblers.pop(e.conn_id, None)
+                with self.lock:
+                    actions = self.router._split(
+                        self.gateway.lost(FED_BASE + e.conn_id, self._clock())
+                    )
+                self._emit_public(actions)
+                continue
+            except lsp.LspError:
+                return  # replica closed
+            if payload.startswith(b"T1|"):
+                asm = assemblers.get(conn_id)
+                if asm is None:
+                    asm = assemblers[conn_id] = FrameAssembler()
+                done, obj = asm.feed(payload)
+                if not done:
+                    continue
+                msg = decode_gossip(obj)
+                if msg is None:
+                    METRICS.inc("federation.gossip_errors")
+                    continue
+                METRICS.inc("federation.gossip_rx")
+                with self.lock:
+                    merged = apply_gossip(self.spans, msg)
+                if merged:
+                    METRICS.inc("federation.gossip_spans_merged", merged)
+                continue
+            m = Message.unmarshal(payload)
+            if m is None or m.type != MsgType.REQUEST:
+                continue  # peers only forward Requests here
+            now = self._clock()
+            with self.lock:
+                actions = self.router._split(
+                    self.gateway.client_request(
+                        FED_BASE + conn_id, m.data, m.lower, m.upper, now,
+                        client_key="fed:peer",
+                    )
+                )
+                evicted = self.router.drain_evictions()
+            self._emit_public(actions)
+            for cid in evicted:
+                try:
+                    self.public.close_conn(cid)
+                except lsp.LspError:
+                    pass
+
+    # ------------------------------------------------------------ forwarding
+
+    def _peer_is_down(self, name: str) -> bool:
+        with self._down_lock:
+            t = self._down.get(name)
+            return t is not None and self._clock() - t < self._peer_down_ttl
+
+    def _mark_peer(self, name: str, down: bool) -> None:
+        with self._down_lock:
+            if down:
+                self._down[name] = self._clock()
+            else:
+                self._down.pop(name, None)
+
+    def _forward_loop(self) -> None:
+        """One forwarder worker: relay queued non-home requests to the
+        home replica's federation port, failing over along the ring; if
+        every peer is unreachable, serve locally.  Each worker keeps one
+        cached conn per peer (a conn carries ONE outstanding request at
+        a time — the scheduler's one-job-per-conn rule)."""
+        clients: Dict[str, "lsp.Client"] = {}
+        try:
+            while True:
+                task = self._fwd_q.get()
+                if task is None:
+                    return
+                conn_id, data, lower, upper, t0 = task
+                result = None
+                order = [n for n in self.ring.route(data) if n != self.cell]
+                candidates = [n for n in order if not self._peer_is_down(n)]
+                for name in candidates:
+                    result = self._forward_once(clients, name, data, lower, upper)
+                    if result is not None:
+                        self._mark_peer(name, down=False)
+                        break
+                    self._mark_peer(name, down=True)
+                    METRICS.inc("federation.forward_failovers")
+                    _trace.emit(
+                        None, "fed", "failover",
+                        cell=self.cell, dead=name, data=data[:64],
+                    )
+                if result is not None:
+                    METRICS.inc("federation.remote_results")
+                    latency = max(0.0, self._clock() - t0)
+                    METRICS.observe("hist.request_s", latency)
+                    with self.lock:
+                        # A peer's Result is the argmin over exactly this
+                        # signature: exact-cache it so the next local twin
+                        # answers without a round trip.  Deregister the
+                        # conn BEFORE the write: a well-behaved client
+                        # only sends its next Request after reading this
+                        # Result, by which time the conn is free again.
+                        self._fwd_conns.discard(conn_id)
+                        self.gateway.cache.put(
+                            (data, lower, upper), result[0], result[1]
+                        )
+                    try:
+                        self.public.write(
+                            conn_id, Message.result(*result).marshal()
+                        )
+                    except lsp.LspError:
+                        self._log.info(
+                            "forward result to %d failed (conn dead)", conn_id
+                        )
+                    continue
+                # Every routable peer refused: the survivors' answer is a
+                # local sweep (correct everywhere beats routed nowhere).
+                METRICS.inc("federation.local_fallbacks")
+                _trace.emit(
+                    None, "fed", "local_fallback", cell=self.cell,
+                    data=data[:64],
+                )
+                with self.lock:
+                    self._fwd_conns.discard(conn_id)  # conn state is the gateway's now
+                    actions = self.router._split(
+                        self.gateway.client_request(
+                            conn_id, data, lower, upper, self._clock(),
+                            client_key="fed:fallback",
+                        )
+                    )
+                self._emit_public(actions)
+        finally:
+            for c in clients.values():
+                try:
+                    c.close()
+                except lsp.LspError:
+                    pass
+
+    def _forward_once(
+        self,
+        clients: Dict[str, "lsp.Client"],
+        name: str,
+        data: str,
+        lower: int,
+        upper: int,
+    ) -> Optional[Tuple[int, int]]:
+        client = clients.get(name)
+        if client is None:
+            host, fport = self.peers[name]
+            try:
+                client = lsp.Client(
+                    host, fport, self.params, label=f"fwd-{self.cell}"
+                )
+            except (lsp.LspError, OSError):
+                return None
+            clients[name] = client
+        got = request_once(client, data, upper, lower=lower)
+        if got is None:
+            # Conn died mid-request (peer killed, or shed us): drop the
+            # cached conn so the next task reconnects fresh.
+            clients.pop(name, None)
+            try:
+                client.close()
+            except lsp.LspError:
+                pass
+        return got

@@ -72,6 +72,12 @@ class _Inflight:
     waiters: List[int] = field(default_factory=list)
     trace: Optional[int] = None
     meta: Dict[int, Tuple[Optional[int], float]] = field(default_factory=dict)
+    #: Span-aware in-flight coalescing (ISSUE 8 satellite): requests for
+    #: a SUB-range of this sweep's range (same data, different key) park
+    #: here instead of re-sweeping the overlap; when the sweep completes,
+    #: its chunk spans are in the store and each parked request replans —
+    #: usually answering whole, at worst sweeping only uncovered slivers.
+    sub_waiters: List["_Queued"] = field(default_factory=list)
 
 
 #: A request parked in the admission queue:
@@ -110,6 +116,8 @@ class Gateway:
         self._by_key: Dict[JobKey, _Inflight] = {}
         self._by_vid: Dict[int, _Inflight] = {}
         self._conn_key: Dict[int, JobKey] = {}  # waiting conn -> signature
+        self._sub_conn: Dict[int, JobKey] = {}  # sub-range waiter -> covering flight's key
+        self._sub_release: List[_Queued] = []  # parked waiters whose sweep just completed
         self._queued_conns: set = set()
         self._queue = FairQueue()
         self._buckets: Dict[str, TokenBucket] = {}
@@ -119,7 +127,11 @@ class Gateway:
     # ------------------------------------------------------------------ events
 
     def miner_joined(self, conn_id: int, now: float = 0.0) -> List[Action]:
-        if conn_id in self._conn_key or conn_id in self._queued_conns:
+        if (
+            conn_id in self._conn_key
+            or conn_id in self._queued_conns
+            or conn_id in self._sub_conn
+        ):
             # Request-then-Join role confusion: the scheduler's own guard
             # (conn in jobs) cannot see it — the job runs under a virtual
             # id — and accepting would leave a phantom miner behind when
@@ -137,6 +149,13 @@ class Gateway:
         # see them (it may now be fully covered).
         for data, lo, hi, h, n in self.sched.drain_spans():
             self.spans.add(data, lo, hi, h, n)
+        # Sub-range waiters parked on a sweep _translate just completed
+        # replan HERE — after the span drain, so the finished sweep's own
+        # chunks are visible to their coverage plan.
+        if self._sub_release:
+            pend, self._sub_release = self._sub_release, []
+            for item in pend:
+                self._release_sub(item, out, now)
         out.extend(self._admit(now))  # a completion may have freed capacity
         return out
 
@@ -160,6 +179,7 @@ class Gateway:
         if (
             conn_id in self._conn_key
             or conn_id in self._queued_conns
+            or conn_id in self._sub_conn
             or conn_id in self.sched.miners
         ):
             return []  # one job per conn; miner/role confusion: ignore
@@ -213,31 +233,43 @@ class Gateway:
             self._conn_key[conn_id] = key
             _trace.emit(tid, "gw", "coalesce", into=flight.trace)
             return []
+        # 2b. Span-aware in-flight coalescing (ISSUE 8 satellite): a
+        # request fully inside a RUNNING sweep's range (same data) parks
+        # on that sweep's completion instead of re-sweeping the overlap —
+        # by then the sweep's chunks are solved spans and the replan
+        # usually answers whole.  Only with the interval store armed:
+        # without spans the wait would end in a full re-sweep anyway.
+        # The park is CAPPED at max_queued per sweep — the admission
+        # queue's own bound (beyond it a request falls through to normal
+        # admission below), and a released waiter whose remainder still
+        # needs device work re-enters admission like any fresh request.
+        if self.spans.enabled and lower <= upper:
+            sup = self._covering_flight(data, lower, upper, key)
+            if sup is not None and len(sup.sub_waiters) < self.max_queued:
+                METRICS.inc("gateway.inflight_span_waits")
+                sup.sub_waiters.append((conn_id, key, ckey, tid, now))
+                self._sub_conn[conn_id] = sup.key
+                _trace.emit(tid, "gw", "span_wait", into=sup.trace)
+                return []
         # 3. Fresh signature: admit, queue, or shed.
         if len(self._by_key) >= self.max_active or not self._take_token(ckey, now):
-            if len(self._queue) >= self.max_queued:
-                # Overflow: make the over-represented key pay, not the
-                # arrival — shedding the newcomer would let one flooder
-                # filling the queue get QUIET clients' conns closed.  Only
-                # when no key is over-represented (or the queue is
-                # disabled) does the arrival itself get shed.
-                victim = self._queue.shed_from_largest()
-                METRICS.inc("gateway.shed")
-                if victim is None:
-                    self._shed.append(conn_id)
-                    _trace.emit(tid, "gw", "shed", conn=conn_id)
-                    return []
-                self._queued_conns.discard(victim[0])
-                self._shed.append(victim[0])
-                _trace.emit(victim[3], "gw", "shed", conn=victim[0])
-            METRICS.inc("gateway.throttled")
-            self._queue.push(ckey, (conn_id, key, ckey, tid, now))
-            self._queued_conns.add(conn_id)
-            _trace.emit(tid, "gw", "queued", backlog=len(self._queue))
+            self._enqueue_or_shed((conn_id, key, ckey, tid, now))
             return []
         return self._submit(conn_id, key, ckey, now, plan=plan, trace=tid)
 
     def lost(self, conn_id: int, now: float = 0.0) -> List[Action]:
+        skey = self._sub_conn.pop(conn_id, None)
+        if skey is not None:
+            # A parked sub-range waiter died: just leave its covering
+            # sweep alone (primary waiters keep it alive).
+            flight = self._by_key.get(skey)
+            if flight is not None:
+                for item in flight.sub_waiters:
+                    if item[0] == conn_id:
+                        flight.sub_waiters.remove(item)
+                        _trace.emit(item[3], "gw", "waiter_lost", conn=conn_id)
+                        break
+            return []
         key = self._conn_key.pop(conn_id, None)
         if key is not None:
             flight = self._by_key.get(key)
@@ -252,6 +284,12 @@ class Gateway:
                     del self._by_key[flight.key]
                     del self._by_vid[flight.vid]
                     out = self._translate(self.sched.lost(flight.vid, now), now)
+                    # Parked sub-range waiters lost their ride: each is an
+                    # independent request — replan it now (the cancelled
+                    # sweep's completed chunks are already solved spans).
+                    for item in flight.sub_waiters:
+                        self._release_sub(item, out, now)
+                    flight.sub_waiters = []
                     out.extend(self._admit(now))
                     return out
             return []
@@ -306,6 +344,7 @@ class Gateway:
             gw_inflight=len(self._by_key),
             gw_waiters=len(self._conn_key),
             gw_queued=len(self._queue),
+            gw_span_waits=len(self._sub_conn),
             gw_cached=len(self.cache),
             gw_spans=len(self.spans),
         )
@@ -429,6 +468,13 @@ class Gateway:
                 _trace.emit(
                     flight.trace, "gw", "fanout", waiters=len(flight.waiters)
                 )
+            if flight.sub_waiters:
+                # Parked sub-range waiters replan AFTER the caller drains
+                # this completion's chunk spans (result() releases them);
+                # the completed Result itself covers a WIDER range, so it
+                # is never their answer.
+                self._sub_release.extend(flight.sub_waiters)
+                flight.sub_waiters = []
         return out
 
     def _admit(self, now: float) -> List[Action]:
@@ -501,6 +547,86 @@ class Gateway:
         )
         self.cache.put(key, best[0], best[1])
         return (conn_id, Message.result(best[0], best[1]))
+
+    def answer_local(
+        self, conn_id: int, data: str, lower: int, upper: int
+    ) -> Optional[Action]:
+        """A zero-work answer from the exact cache or fully-covering
+        solved spans, creating NO gateway state — for shells (the
+        federation router) that must decide locally-answerable vs
+        route-elsewhere before any event reaches the gateway.  Valid
+        non-empty ranges only: empty/poison ranges must flow through
+        ``client_request`` so its guards see them."""
+        if lower > upper or lower < 0 or upper >= 1 << 64:
+            return None
+        key: JobKey = (data, lower, upper)
+        hit = self.cache.get(key)
+        if hit is not None:
+            METRICS.inc("gateway.cache_hits")
+            METRICS.observe("hist.request_s", 0.0)
+            return (conn_id, Message.result(hit[0], hit[1]))
+        answer = self._span_answer(conn_id, key)
+        if answer is not None:
+            METRICS.observe("hist.request_s", 0.0)
+        return answer
+
+    def _covering_flight(
+        self, data: str, lower: int, upper: int, key: JobKey
+    ) -> Optional[_Inflight]:
+        """A running sweep whose range contains ``[lower, upper]`` on the
+        same data (a different signature — exact twins coalesce earlier).
+        O(in-flight) scan, bounded by ``max_active``."""
+        for fkey, flight in self._by_key.items():
+            fdata, flo, fhi = fkey
+            if fdata == data and fkey != key and flo <= lower and upper <= fhi:
+                return flight
+        return None
+
+    def _enqueue_or_shed(self, item: _Queued) -> None:
+        """Park ``item`` in the admission queue, shedding on overflow:
+        make the over-represented key pay, not the arrival — shedding the
+        newcomer would let one flooder filling the queue get QUIET
+        clients' conns closed.  Only when no key is over-represented (or
+        the queue is disabled) does the arrival itself get shed."""
+        conn_id, key, ckey, tid, t_enq = item
+        if len(self._queue) >= self.max_queued:
+            victim = self._queue.shed_from_largest()
+            METRICS.inc("gateway.shed")
+            if victim is None:
+                self._shed.append(conn_id)
+                _trace.emit(tid, "gw", "shed", conn=conn_id)
+                return
+            self._queued_conns.discard(victim[0])
+            self._shed.append(victim[0])
+            _trace.emit(victim[3], "gw", "shed", conn=victim[0])
+        METRICS.inc("gateway.throttled")
+        self._queue.push(ckey, item)
+        self._queued_conns.add(conn_id)
+        _trace.emit(tid, "gw", "queued", backlog=len(self._queue))
+
+    def _release_sub(
+        self, item: _Queued, out: List[Action], now: float
+    ) -> None:
+        """Replan one parked sub-range waiter: its covering sweep is gone
+        (completed or cancelled), so answer from the cache/spans, coalesce
+        into a live twin, or sweep the remainder — through NORMAL
+        admission.  The free ride ended with the covering sweep: a
+        remainder that still needs device work pays a token and an active
+        slot like any fresh request (a cancelled sweep releasing
+        max_queued parked waiters must not fan out past max_active), and
+        when capacity is tight it queues with its ORIGINAL request time so
+        latency accounting stays honest."""
+        conn_id = item[0]
+        self._sub_conn.pop(conn_id, None)
+        if self._resolve_twin(item, out, now):
+            return
+        _cid, key, ckey, tid, t_enq = item
+        if len(self._by_key) >= self.max_active or not self._take_token(ckey, now):
+            self._enqueue_or_shed(item)
+            return
+        out.extend(
+            self._submit(conn_id, key, ckey, now, trace=tid, t_req=t_enq)
+        )
 
     def _resolve_twin(
         self, item: _Queued, out: List[Action], now: float = 0.0

@@ -95,11 +95,20 @@ class LinkConditions:
     jitter_ms: float = 0.0  # uniform ±jitter around delay_ms
     reorder_delay_ms: float = 30.0  # how far a reordered packet lags
     ge: Optional[GEParams] = None  # burst-loss model
+    #: Bandwidth cap (ISSUE 8 satellite, carry-over from PR 2): a
+    #: token-bucket shaper in BYTES/sec per link.  A packet that finds
+    #: insufficient credit is not dropped — it queues, i.e. it is
+    #: delivered with the delay the backlog implies (classic shaping:
+    #: credit may go negative, successive packets see a growing queue).
+    #: 0 = unlimited; ``burst_bytes`` is the bucket depth.
+    rate_bps: float = 0.0
+    burst_bytes: float = 4096.0
 
     def __post_init__(self) -> None:
         for f in ("drop", "duplicate", "reorder"):
             object.__setattr__(self, f, _clamp_pct(getattr(self, f)))
-        for f in ("delay_ms", "jitter_ms", "reorder_delay_ms"):
+        for f in ("delay_ms", "jitter_ms", "reorder_delay_ms",
+                  "rate_bps", "burst_bytes"):
             object.__setattr__(self, f, max(0.0, float(getattr(self, f))))
 
     @property
@@ -115,15 +124,19 @@ _PASS: Decision = (False, False, 0.0, False)
 
 
 class _LinkState:
-    """Per-(key, direction) mutable state: one RNG stream + GE state."""
+    """Per-(key, direction) mutable state: one RNG stream + GE state +
+    the bandwidth shaper's token bucket (``tokens`` may run negative =
+    queued backlog; ``t_last`` is the last refill observation)."""
 
-    __slots__ = ("rng", "ge_bad")
+    __slots__ = ("rng", "ge_bad", "tokens", "t_last")
 
     def __init__(self, seed: int, key: str, direction: str) -> None:
         # Stable stream derivation: same seed + same key → same stream,
         # independent of creation order or how many other links exist.
         self.rng = random.Random((seed << 32) ^ crc32(f"{key}/{direction}".encode()))
         self.ge_bad = False
+        self.tokens: Optional[float] = None  # None until the shaper first runs
+        self.t_last = 0.0
 
 
 class Schedule:
@@ -357,8 +370,12 @@ class NetSim:
 
     # ------------------------------------------------------------- decisions
 
-    def on_send(self, label: Optional[str], is_server: bool) -> Decision:
-        """Decide one outbound packet's fate.  Called by UDPEndpoint.send."""
+    def on_send(
+        self, label: Optional[str], is_server: bool, size: int = 0
+    ) -> Decision:
+        """Decide one outbound packet's fate.  Called by UDPEndpoint.send;
+        ``size`` is the datagram's byte length (the bandwidth shaper's
+        charge — 0 from legacy callers means shaping never engages)."""
         if not self._enabled:  # unguarded: benign racy fast path — a stale False costs one clean packet, never a wrong decision
             return _PASS
         if self._schedule:  # unguarded: racy peek; _advance re-checks under _lock
@@ -399,6 +416,25 @@ class NetSim:
             reordered = cond.reorder > 0 and rng.random() * 100.0 < cond.reorder
             if reordered:
                 delay += cond.reorder_delay_ms / 1000.0
+            if cond.rate_bps > 0 and size > 0:
+                # Token-bucket shaping: refill since the last packet (to
+                # the burst cap), charge this one; a negative balance is
+                # the queue, and the time to pay it back is the queueing
+                # delay — so a gossip or telemetry link capped at N bytes/s
+                # degrades to lag, not loss.
+                now_s = self._clock()
+                if st.tokens is None:
+                    st.tokens = cond.burst_bytes
+                else:
+                    st.tokens = min(
+                        cond.burst_bytes,
+                        st.tokens + (now_s - st.t_last) * cond.rate_bps,
+                    )
+                st.t_last = now_s
+                st.tokens -= size
+                if st.tokens < 0:
+                    delay += -st.tokens / cond.rate_bps
+                    self._count("throttled")
             if dup:
                 self._count("duplicated")
             if reordered:

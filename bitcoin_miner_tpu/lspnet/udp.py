@@ -125,7 +125,9 @@ class UDPEndpoint:
             if FAULTS.debug:
                 print(f"lspnet: DROPPING written packet of length {len(data)}")
             return
-        drop, dup, delay, _reordered = CHAOS.on_send(self.label, self.is_server)
+        drop, dup, delay, _reordered = CHAOS.on_send(
+            self.label, self.is_server, len(data)
+        )
         if drop:
             if FAULTS.debug:
                 print(f"lspnet: CHAOS dropped packet of length {len(data)}")

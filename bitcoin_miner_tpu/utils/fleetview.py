@@ -225,6 +225,72 @@ class FleetView:
         out.sort(key=lambda d: -d["ratio"])
         return out
 
+    # ------------------------------------------------------- federation (ISSUE 8)
+
+    def export_sources(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """Every source's latest raw snapshot + its age — the per-CELL
+        export a federation-level view ingests.  Unlike ``merged_state``
+        this keeps per-source resolution, so the federation straggler
+        detector still names the right miner."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            items = [
+                (
+                    name,
+                    dict(s.counters),
+                    dict(s.gauges),
+                    dict(s.hist_states),
+                    s.seq,
+                    max(0.0, now - s.last_seen),
+                )
+                for name, s in self._sources.items()
+            ]
+        return {
+            name: {
+                "counters": counters,
+                "gauges": gauges,
+                "hists": hists,
+                "seq": seq,
+                "age_s": age,
+            }
+            for name, counters, gauges, hists, seq, age in items
+        }
+
+    def ingest_cell(
+        self, cell: str, export: dict, now: Optional[float] = None
+    ) -> int:
+        """Fold one cell's :meth:`export_sources` into this (federation)
+        view as ``cell/source`` entries; returns sources accepted.
+
+        No double counting by construction: snapshots are ABSOLUTE
+        per-source states, so re-ingesting the same export replaces
+        rather than adds, and the cell prefix keeps a name that happens
+        to exist in two cells as two distinct sources.  Ages carry over
+        (``last_seen = now - age_s``), so a source stale in its cell is
+        stale in the federation view too."""
+        if not isinstance(export, dict):
+            return 0
+        now = self._clock() if now is None else now
+        merged = 0
+        for name, st in export.items():
+            if not isinstance(name, str) or not isinstance(st, dict):
+                continue
+            age = st.get("age_s", 0.0)
+            if not isinstance(age, (int, float)) or age < 0:
+                age = 0.0
+            if self.ingest(
+                f"{cell}/{name}",
+                {
+                    "counters": st.get("counters") or {},
+                    "gauges": st.get("gauges") or {},
+                    "hists": st.get("hists") or {},
+                    "seq": st.get("seq"),
+                },
+                now=now - age,
+            ):
+                merged += 1
+        return merged
+
     def merged_state(
         self,
         now: Optional[float] = None,

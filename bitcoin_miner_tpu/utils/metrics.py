@@ -323,6 +323,17 @@ def format_quantiles(h) -> str:
 #:   gateway.span_partial      requests that swept only their uncovered gaps
 #:   gateway.nonces_saved      nonces answered from spans instead of swept
 #:   gateway.span_evictions    span-store data keys dropped by the LRU bound
+#:   gateway.inflight_span_waits  sub-range requests parked on a covering running sweep
+#:   federation.forwarded      requests routed to their home replica's federation port
+#:   federation.local_answers  non-home requests answered from local cache/gossiped spans
+#:   federation.forward_failovers  forward attempts re-routed past a dead replica
+#:   federation.local_fallbacks    forwards served locally (every peer unreachable)
+#:   federation.remote_results     forwarded requests answered by a peer's Result
+#:   federation.gossip_beats   span-gossip messages sent to a peer
+#:   federation.gossip_frames  span-gossip datagrams written (each under the wire ceiling)
+#:   federation.gossip_rx      span-gossip messages received and decoded
+#:   federation.gossip_spans_merged  peer spans folded into the local span store
+#:   federation.gossip_errors  gossip sends/decodes/beats that failed
 #:   miner.nonces              nonces swept by this process's miner loop
 #:   miner.reconnects          successful re-Joins after a lost server conn
 #:   miner.tier_downgrades     kernel tiers abandoned by the sweep watchdog
@@ -332,6 +343,7 @@ def format_quantiles(h) -> str:
 #:   chaos.duplicated          packets the simulator emitted twice
 #:   chaos.reordered           packets given the reorder extra delay
 #:   chaos.delayed             packets delivered late (delay/jitter/reorder)
+#:   chaos.throttled           packets queued by a token-bucket bandwidth cap
 #:   telemetry.exports         metric snapshots shipped over the sidecar channel
 #:   telemetry.export_errors   snapshot sends/connects that failed (channel down)
 #:   telemetry.snapshots_merged  snapshots folded into the server's fleet view

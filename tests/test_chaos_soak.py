@@ -39,6 +39,7 @@ from bitcoin_miner_tpu.lspnet.chaos import (
     conditions,
     heal,
     partition,
+    standard_scenarios,
 )
 from bitcoin_miner_tpu.utils.metrics import METRICS
 
@@ -630,3 +631,187 @@ def test_chaos_replay_tool_smoke():
     assert run.returncode == 0, run.stdout + run.stderr
     report = json.loads(run.stdout.strip().splitlines()[-1])
     assert report["ok"] is True
+
+
+# --------------------------------------------------------------------------
+# 4. Packet-level bandwidth caps (ISSUE 8 satellite, carry-over from PR 2)
+# --------------------------------------------------------------------------
+
+
+class TestBandwidthCap:
+    """Token-bucket bytes/s shaping per link: insufficient credit queues
+    the packet (delivery delay), never drops it."""
+
+    def _sim(self, **cond):
+        sim = NetSim()
+        clock = [0.0]
+        sim.run(Schedule().at(0.0, conditions(**cond)),
+                clock=lambda: clock[0])
+        return sim, clock
+
+    def test_burst_passes_then_backlog_queues(self):
+        sim, clock = self._sim(rate_bps=1000, burst_bytes=1000)
+        d1 = sim.on_send(None, False, 600)  # within the burst credit
+        d2 = sim.on_send(None, False, 600)  # 200 bytes over: 0.2s queue
+        d3 = sim.on_send(None, False, 1000)  # behind d2: 1.2s total backlog
+        assert d1[0] is False and d1[2] == 0.0
+        assert d2[0] is False and abs(d2[2] - 0.2) < 1e-9
+        assert d3[0] is False and abs(d3[2] - 1.2) < 1e-9
+        assert sim.counters()["throttled"] == 2
+
+    def test_idle_time_refills_up_to_burst(self):
+        sim, clock = self._sim(rate_bps=1000, burst_bytes=1000)
+        sim.on_send(None, False, 1000)
+        sim.on_send(None, False, 500)  # 0.5s backlog
+        clock[0] = 10.0  # long idle: credit refills, capped at burst
+        d = sim.on_send(None, False, 1000)
+        assert d[2] == 0.0
+        d = sim.on_send(None, False, 400)
+        assert abs(d[2] - 0.4) < 1e-9
+
+    def test_per_link_buckets_are_independent(self):
+        sim = NetSim()
+        clock = [0.0]
+        sim.run(
+            Schedule().at(0.0, conditions("gossip-r1", rate_bps=100,
+                                          burst_bytes=100)),
+            clock=lambda: clock[0],
+        )
+        # The capped label queues; an uncapped peer label does not.
+        assert sim.on_send("gossip-r1", False, 100)[2] == 0.0
+        assert sim.on_send("gossip-r1", False, 100)[2] > 0.0
+        assert sim.on_send("gossip-r2", False, 10_000)[2] == 0.0
+        # A second capped link would have its own credit (derived per
+        # (key, direction)), so the r1 backlog never leaks across links.
+        assert sim.counters()["throttled"] == 1
+
+    def test_zero_rate_means_unlimited(self):
+        sim, clock = self._sim(delay_ms=0, rate_bps=0)
+        for _ in range(50):
+            assert sim.on_send(None, False, 10_000) == (False, False, 0.0, False)
+        assert "throttled" not in sim.counters()
+
+    def test_shaped_link_still_delivers_e2e(self):
+        """A throttled loopback fleet: the serving link capped hard enough
+        to engage the shaper, the Result still lands bit-exact (shaping
+        degrades to lag, not loss)."""
+        CHAOS.reset()
+        CHAOS.set_conditions("server", rate_bps=64_000, burst_bytes=2_000)
+        server = lsp.Server(0, PARAMS, label="server")
+        sched = Scheduler(min_chunk=500)
+        threading.Thread(
+            target=server_mod.serve, args=(server, sched),
+            kwargs={"tick_interval": 0.05}, daemon=True,
+        ).start()
+        mc = lsp.Client("127.0.0.1", server.port, PARAMS)
+        threading.Thread(
+            target=miner_mod.run_miner,
+            args=(mc, miner_mod.make_search("cpu")), daemon=True,
+        ).start()
+        try:
+            c = lsp.Client("127.0.0.1", server.port, PARAMS)
+            try:
+                got = client_mod.request_once(c, "shaped", 3000)
+            finally:
+                c.close()
+            assert got == min_hash_range("shaped", 0, 3000)
+            assert METRICS.get("chaos.throttled") > 0
+        finally:
+            CHAOS.reset()
+            server.close()
+
+
+# --------------------------------------------------------------------------
+# 5. Gateway + interval store under the chaos soak (ISSUE 8 satellite,
+#    carry-over from PR 3/5): shed/coalesce/span-flush under seeded burst
+#    loss, green under the race sanitizer.
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.gateway
+@pytest.mark.analysis
+def test_gateway_interval_store_chaos_soak_sanitized(tmp_path):
+    """An overlap-heavy burst-lossy soak through the FULL serving stack —
+    admission (small max_active/max_queued so requests queue and shed),
+    coalescing, the interval store with disk persistence — with
+    BMT_SANITIZE=1 machinery armed.  Shed/retried clients resubmit; every
+    final answer is oracle-bit-exact; the span store flushes to disk and
+    reloads."""
+    from bitcoin_miner_tpu.gateway import Gateway, ResultCache, SpanStore
+    from bitcoin_miner_tpu.utils import sanitize
+
+    sanitize.force(True)
+    sanitize.reset_order_graph()
+    CHAOS.reset()
+    CHAOS.seed(23)
+    CHAOS.run(standard_scenarios()["burst-loss"], loop_every=2.0)
+    spans_path = str(tmp_path / "spans.json")
+    server = lsp.Server(0, PARAMS, label="server")
+    gw = Gateway(
+        Scheduler(min_chunk=500),
+        cache=ResultCache(),
+        spans=SpanStore(path=spans_path),
+        rate=None,
+        max_active=2,
+        max_queued=4,
+    )
+    threading.Thread(
+        target=server_mod.serve, args=(server, gw),
+        kwargs={"tick_interval": 0.05}, daemon=True,
+    ).start()
+    for _ in range(2):
+        mc = lsp.Client("127.0.0.1", server.port, PARAMS)
+        threading.Thread(
+            target=miner_mod.run_miner,
+            args=(mc, miner_mod.make_search("cpu")), daemon=True,
+        ).start()
+    # Nested/overlapping signatures over two data keys: coalesce hits,
+    # span answers and queued/shed admission all engage at once.
+    jobs = [
+        ("soak-a", 0, 4000), ("soak-a", 0, 4000), ("soak-a", 1000, 3000),
+        ("soak-b", 0, 3000), ("soak-b", 500, 2500), ("soak-a", 0, 2000),
+        ("soak-b", 0, 3000), ("soak-a", 2000, 4000),
+    ]
+    out = {}
+
+    def one(i):
+        data, lo, hi = jobs[i]
+        # Shed conns close like dead clients; resubmit like a real client
+        # (the identical signature resumes/coalesces server-side).
+        for _ in range(6):
+            try:
+                c = lsp.Client("127.0.0.1", server.port, PARAMS)
+            except (lsp.LspError, OSError):
+                continue
+            try:
+                got = client_mod.request_once(c, data, hi, lower=lo)
+            finally:
+                try:
+                    c.close()
+                except lsp.LspError:
+                    pass
+            if got is not None:
+                out[i] = got
+                return
+
+    try:
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(len(jobs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "soak client starved"
+        for i, (data, lo, hi) in enumerate(jobs):
+            assert out.get(i) == min_hash_range(data, lo, hi), f"job {i}"
+        assert METRICS.get("chaos.dropped") > 0  # the loss was real
+    finally:
+        CHAOS.reset()
+        server.close()
+        sanitize.force(None)
+        sanitize.reset_order_graph()
+    # The final flush persisted the solved spans; a cold store reloads
+    # them and still answers a covered sub-range of the soaked work.
+    from bitcoin_miner_tpu.gateway.cache import SpanStore as ColdStore
+
+    cold = ColdStore(path=spans_path)
+    assert len(cold) > 0

@@ -1,0 +1,568 @@
+"""The federated serving tier (ISSUE 8).
+
+Three layers, mirroring how the gateway is tested:
+
+- pure-unit: the consistent-hash ring (determinism, failover order) and
+  the gossip codec/journal (round-trip through the telemetry frame
+  machinery, every datagram under the frozen 1000-byte wire ceiling);
+- replica e2e over loopback LSP: routing (a request landing on a
+  non-home replica forwards and answers bit-exact), duplicate collapse
+  across replicas, gossip convergence (a range solved on replica A
+  answers a covered sub-range at replica B with ZERO chunks assigned),
+  failover past a dead home, and the local fallback when every peer is
+  gone;
+- seeded drills (the ISSUE 8 acceptance): a scheduler cell killed
+  mid-sweep with the client resubmitting through a survivor —
+  whole-range-correct, oracle-bit-exact — and a gossip-link partition
+  that leaves one replica stale until it heals and converges.
+"""
+
+import threading
+import time
+
+import pytest
+
+from bitcoin_miner_tpu import lsp, lspnet
+from bitcoin_miner_tpu.apps import client as client_mod
+from bitcoin_miner_tpu.apps import miner as miner_mod
+from bitcoin_miner_tpu.apps.scheduler import Scheduler
+from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+from bitcoin_miner_tpu.federation import (
+    GossipSpanStore,
+    Replica,
+    Ring,
+    decode_gossip,
+    encode_gossip,
+)
+from bitcoin_miner_tpu.federation.gossip import apply_gossip
+from bitcoin_miner_tpu.lspnet.chaos import CHAOS
+from bitcoin_miner_tpu.utils.metrics import METRICS
+from bitcoin_miner_tpu.utils.telemetry import FrameAssembler
+
+from lsp_harness import random_port
+
+pytestmark = pytest.mark.federation
+
+PARAMS = lsp.Params(epoch_limit=5, epoch_millis=200, window_size=5)
+
+
+@pytest.fixture(autouse=True)
+def _clean_network():
+    lspnet.reset_faults()
+    CHAOS.reset()
+    yield
+    lspnet.reset_faults()
+    CHAOS.reset()
+
+
+# ---------------------------------------------------------------------- ring
+
+
+class TestRing:
+    def test_deterministic_and_order_independent(self):
+        a = Ring(["r1", "r2", "r3"])
+        b = Ring(["r3", "r1", "r2"])
+        for key in ("alpha", "beta", "gamma", "cmu440", ""):
+            assert a.route(key) == b.route(key)
+
+    def test_route_is_a_permutation_of_names(self):
+        ring = Ring(["r1", "r2", "r3", "r4"])
+        order = ring.route("somedata")
+        assert sorted(order) == ["r1", "r2", "r3", "r4"]
+        assert order[0] == ring.home("somedata")
+
+    def test_spread_over_keys(self):
+        # Not a distribution test, just non-degeneracy: with vnodes, many
+        # keys must not all land on one replica.
+        ring = Ring(["r1", "r2", "r3"])
+        homes = {ring.home(f"key{i}") for i in range(64)}
+        assert len(homes) == 3
+
+    def test_alive_filter_preserves_order_and_falls_back(self):
+        ring = Ring(["r1", "r2", "r3"])
+        order = ring.route("data")
+        # Dropping the home promotes the next name, preserving order.
+        alive = [n for n in order if n != order[0]]
+        assert ring.route("data", alive=alive) == order[1:]
+        # An empty liveness view falls back to the unfiltered order.
+        assert ring.route("data", alive=[]) == order
+
+    def test_single_replica_ring(self):
+        ring = Ring(["solo"])
+        assert ring.home("anything") == "solo"
+        assert ring.route("anything") == ["solo"]
+
+    def test_stability_under_membership_change(self):
+        # Consistent hashing's point: removing one replica only moves the
+        # keys that replica owned.
+        big = Ring(["r1", "r2", "r3", "r4"])
+        small = Ring(["r1", "r2", "r3"])
+        moved = 0
+        for i in range(200):
+            key = f"key{i}"
+            if big.home(key) != "r4" and small.home(key) != big.home(key):
+                moved += 1
+        assert moved == 0
+
+
+# -------------------------------------------------------------------- gossip
+
+
+class TestGossipCodec:
+    def test_roundtrip_through_frame_assembler(self):
+        spans = [(f"data{i}", i * 100, i * 100 + 99, 12345 + i, i * 100 + 7)
+                 for i in range(50)]
+        frames = encode_gossip("r1", 3, spans, full=True)
+        asm = FrameAssembler()
+        objs = [asm.feed(f) for f in frames]
+        done, obj = objs[-1]
+        assert done and obj is not None
+        msg = decode_gossip(obj)
+        assert msg is not None
+        assert msg["from"] == "r1" and msg["full"] is True
+        assert [tuple(s) for s in msg["spans"]] == spans
+
+    def test_every_datagram_under_wire_ceiling(self):
+        from bitcoin_miner_tpu.lsp.message import Message as LspMessage
+
+        # A big full sync: hundreds of spans with long data keys.
+        spans = [
+            (f"some-rather-long-data-key-{i:04d}", i * 1000,
+             i * 1000 + 999, (i * 2654435761) % (1 << 64), i * 1000 + 13)
+            for i in range(400)
+        ]
+        frames = encode_gossip("replica-with-a-name", 9, spans, full=True)
+        assert len(frames) > 1  # actually fragmented
+        for i, f in enumerate(frames):
+            wire = LspMessage.data(999999, 999999, len(f), f).marshal()
+            assert len(wire) <= lsp.MAX_MESSAGE_SIZE, (i, len(wire))
+
+    def test_decode_rejects_alien_payloads(self):
+        assert decode_gossip(None) is None
+        assert decode_gossip({"v": 2, "kind": "spans"}) is None
+        assert decode_gossip({"v": 1, "kind": "other", "from": "x"}) is None
+        assert decode_gossip(
+            {"v": 1, "kind": "spans", "from": "x", "spans": "nope"}
+        ) is None
+
+    def test_apply_skips_bad_rows(self):
+        store = GossipSpanStore()
+        msg = {
+            "v": 1, "kind": "spans", "from": "r2",
+            "spans": [
+                ["good", 0, 99, 5, 7],
+                ["short", 1],
+                ["bad-types", "0", 99, 5, 7],
+                ["good2", 100, 199, 4, 150],
+            ],
+        }
+        assert apply_gossip(store, msg) == 2
+        assert store.cover("good", 0, 99)[1] == []
+
+
+class TestGossipStore:
+    def test_local_adds_journal_remote_adds_do_not(self):
+        store = GossipSpanStore()
+        store.add("a", 0, 99, 50, 10)
+        store.add_remote("b", 0, 99, 60, 20)
+        drained = store.drain_journal()
+        assert drained == [("a", 0, 99, 50, 10)]
+        assert store.drain_journal() == []  # drain is destructive
+        # Both landed in the store itself.
+        assert store.cover("a", 0, 99)[1] == []
+        assert store.cover("b", 0, 99)[1] == []
+
+    def test_refused_spans_do_not_journal(self):
+        store = GossipSpanStore()
+        store.add("a", 99, 0, 5, 7)  # empty
+        store.add("a", 0, 99, 5, 500)  # argmin outside
+        assert store.drain_journal() == []
+
+    def test_journal_bounded(self):
+        store = GossipSpanStore(journal_max=4)
+        for i in range(10):
+            store.add(f"d{i}", 0, 9, 5, 3)
+        assert len(store.drain_journal()) == 4
+
+    def test_export_spans_is_full_state(self):
+        store = GossipSpanStore()
+        store.add("a", 0, 99, 50, 10)
+        store.add_remote("b", 200, 299, 40, 250)
+        exported = sorted(store.export_spans())
+        assert exported == [("a", 0, 99, 50, 10), ("b", 200, 299, 40, 250)]
+
+
+# -------------------------------------------------------------- replica e2e
+
+
+class FedFleet:
+    """An in-process federation: N replicas, each with its own miners."""
+
+    def __init__(self, n=2, miners=1, min_chunk=500, gossip_interval=0.15,
+                 **replica_kwargs):
+        names = [f"r{i}" for i in range(n)]
+        fed_ports = {nm: random_port() + i for i, nm in enumerate(names)}
+        self.replicas = {}
+        for nm in names:
+            peers = {o: ("127.0.0.1", fed_ports[o]) for o in names if o != nm}
+            self.replicas[nm] = Replica(
+                nm,
+                peers,
+                fed_port=fed_ports[nm],
+                params=PARAMS,
+                scheduler=Scheduler(min_chunk=min_chunk),
+                gossip_interval=gossip_interval,
+                tick_interval=0.05,
+                **replica_kwargs,
+            ).start()
+        self.miners = []
+        for nm in names:
+            for _ in range(miners):
+                self.add_miner(nm)
+
+    def add_miner(self, name):
+        c = lsp.Client("127.0.0.1", self.replicas[name].port, PARAMS,
+                       label=f"miner-{name}")
+        threading.Thread(
+            target=miner_mod.run_miner,
+            args=(c, miner_mod.make_search("cpu")),
+            daemon=True,
+        ).start()
+        self.miners.append(c)
+        return c
+
+    def request_at(self, name, data, max_nonce, lower=0):
+        c = lsp.Client("127.0.0.1", self.replicas[name].port, PARAMS)
+        try:
+            return client_mod.request_once(c, data, max_nonce, lower=lower)
+        finally:
+            c.close()
+
+    def request_at_fed_port(self, name, data, max_nonce, lower=0):
+        """The local-serve path: federation-port requests never forward,
+        so the answer provably comes from this replica's own state."""
+        c = lsp.Client("127.0.0.1", self.replicas[name].fed_port, PARAMS)
+        try:
+            return client_mod.request_once(c, data, max_nonce, lower=lower)
+        finally:
+            c.close()
+
+    def ring(self):
+        return Ring(list(self.replicas))
+
+    def home_and_other(self, data):
+        home = self.ring().home(data)
+        other = next(nm for nm in self.replicas if nm != home)
+        return home, other
+
+    def close(self):
+        for rep in self.replicas.values():
+            rep.close()
+
+
+def _wait(pred, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def test_forwarded_request_answers_bit_exact():
+    """A request arriving at a NON-home replica forwards to the home and
+    the client still gets the oracle answer; the home's cache makes a
+    repeat at the forwarding replica zero-chunk."""
+    METRICS.reset()
+    fleet = FedFleet(n=2)
+    try:
+        data, hi = "fedalpha", 3000
+        home, other = fleet.home_and_other(data)
+        want = min_hash_range(data, 0, hi)
+        assert fleet.request_at(other, data, hi) == want
+        assert METRICS.get("federation.forwarded") >= 1
+        assert METRICS.get("federation.remote_results") >= 1
+        # Repeat at the SAME non-home replica: its forward-populated exact
+        # cache answers locally with zero new chunks — and WITHOUT another
+        # round trip to the home cell.
+        assigned = METRICS.get("sched.chunks_assigned")
+        forwarded = METRICS.get("federation.forwarded")
+        assert fleet.request_at(other, data, hi) == want
+        assert METRICS.get("sched.chunks_assigned") == assigned
+        assert METRICS.get("federation.forwarded") == forwarded
+        assert METRICS.get("federation.local_answers") >= 1
+        # And at the home replica: the home solved it, cache hit there too.
+        assert fleet.request_at(home, data, hi) == want
+        assert METRICS.get("sched.chunks_assigned") == assigned
+    finally:
+        fleet.close()
+
+
+def test_duplicates_collapse_across_replicas():
+    """Concurrent twins sprayed at BOTH replicas coalesce into one sweep
+    on the home cell — the consistent-hash-routing acceptance shape."""
+    METRICS.reset()
+    fleet = FedFleet(n=2)
+    try:
+        data, hi = "fedcoal", 4000
+        want = min_hash_range(data, 0, hi)
+        out = {}
+
+        def one(i, name):
+            out[i] = fleet.request_at(name, data, hi)
+
+        names = list(fleet.replicas) * 3
+        threads = [
+            threading.Thread(target=one, args=(i, nm))
+            for i, nm in enumerate(names)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "client starved"
+        assert all(v == want for v in out.values()), out
+        # One underlying sweep signature: every completion beyond the
+        # first came from coalescing/cache, not a second sweep.
+        assert METRICS.get("gateway.completed") <= 2
+    finally:
+        fleet.close()
+
+
+def test_gossip_spans_answer_on_other_replica_zero_chunks():
+    """The cross-replica span-reuse acceptance (ISSUE 8): replica A
+    solves a range; after gossip, a covered sub-range queried at replica
+    B's federation port (local serve — no forwarding) answers bit-exact
+    with ZERO chunks assigned anywhere."""
+    METRICS.reset()
+    fleet = FedFleet(n=2)
+    try:
+        data, hi = "fedgossip", 5000
+        home, other = fleet.home_and_other(data)
+        want = min_hash_range(data, 0, hi)
+        assert fleet.request_at(home, data, hi) == want
+        rep_b = fleet.replicas[other]
+
+        def covered():
+            with rep_b.lock:
+                best, gaps = rep_b.spans.cover(data, want[1], hi)
+                return best is not None and not gaps
+
+        assert _wait(covered, timeout=10.0), "gossip never converged"
+        assigned = METRICS.get("sched.chunks_assigned")
+        got = fleet.request_at_fed_port(other, data, hi, lower=want[1])
+        assert got == min_hash_range(data, want[1], hi)
+        assert METRICS.get("sched.chunks_assigned") == assigned
+        # All gossip datagrams respected the frozen wire ceiling.
+        for rep in fleet.replicas.values():
+            assert rep.gossip.max_frame_bytes <= 700
+        assert METRICS.get("federation.gossip_spans_merged") >= 1
+    finally:
+        fleet.close()
+
+
+def test_cell_kill_mid_sweep_survivors_serve_whole_range():
+    """The ISSUE 8 chaos drill, cell-kill half: kill a scheduler cell
+    mid-sweep; the client resubmits through a surviving replica and still
+    receives a whole-range-correct, oracle-bit-exact Result."""
+    METRICS.reset()
+    fleet = FedFleet(n=2, min_chunk=200)
+    try:
+        # Find a data key homed on r1 so we can kill r1 mid-sweep.
+        data = next(
+            f"kill{i}" for i in range(64)
+            if fleet.ring().home(f"kill{i}") == "r1"
+        )
+        hi = 60_000
+        want = min_hash_range(data, 0, hi)
+        victim = fleet.replicas["r1"]
+        box = {}
+
+        def client_with_resubmit():
+            # First attempt at the home replica dies with it; the retry
+            # goes through the SURVIVOR's public port (the load-balancer
+            # failover a real client implements).
+            got = fleet.request_at("r1", data, hi)
+            if got is None:
+                got = fleet.request_at("r0", data, hi)
+            box["got"] = got
+
+        t = threading.Thread(target=client_with_resubmit, daemon=True)
+        t.start()
+        # Let the sweep start, then kill the whole cell mid-sweep.
+        assert _wait(
+            lambda: METRICS.get("sched.chunks_assigned") > 0, timeout=30.0
+        )
+        victim.close()
+        t.join(timeout=120)
+        assert not t.is_alive(), "client starved after cell kill"
+        assert box["got"] == want
+    finally:
+        fleet.close()
+
+
+def test_forward_fails_over_to_ring_successor_when_home_dead():
+    """With the home cell dead, a request at a surviving replica is NOT
+    forwarded into the void: the forwarder fails over along the ring
+    (here: back to the survivor itself via local fallback) and the
+    client still gets the oracle answer."""
+    METRICS.reset()
+    fleet = FedFleet(n=2)
+    try:
+        data = next(
+            f"dead{i}" for i in range(64)
+            if fleet.ring().home(f"dead{i}") == "r1"
+        )
+        hi = 2500
+        fleet.replicas["r1"].close()  # the home cell is gone
+        want = min_hash_range(data, 0, hi)
+        got = fleet.request_at("r0", data, hi)
+        assert got == want
+        # The forward either failed over and fell back locally (counted),
+        # or r0 served it after marking the peer down.
+        assert (
+            METRICS.get("federation.local_fallbacks") >= 1
+            or METRICS.get("federation.forward_failovers") >= 1
+        )
+    finally:
+        fleet.close()
+
+
+def test_gossip_partition_stale_then_heals_and_converges():
+    """The ISSUE 8 chaos drill, gossip-partition half: partition one
+    replica's gossip channel; a range solved on the other replica stays
+    unknown to it (stale) while the partition holds — requests still
+    answer correctly via forwarding — then the partition lifts and the
+    stale replica converges (full-sync anti-entropy), after which a
+    covered sub-range answers locally with zero chunks."""
+    METRICS.reset()
+    fleet = FedFleet(n=2, gossip_interval=0.15)
+    try:
+        data, hi = "fedpart", 5000
+        home, other = fleet.home_and_other(data)
+        rep_b = fleet.replicas[other]
+        # Cut the HOME replica's gossip tx (its label: gossip-<home>).
+        CHAOS.partition(f"gossip-{home}", "both")
+        want = min_hash_range(data, 0, hi)
+        assert fleet.request_at(home, data, hi) == want
+
+        def b_has_spans():
+            with rep_b.lock:
+                return len(rep_b.spans._maps.get(data, ())) > 0
+
+        # Stale while partitioned: give gossip several beats to (not)
+        # arrive.  Requests still answer bit-exact meanwhile (forwarding
+        # is a different link).
+        time.sleep(1.0)
+        assert not b_has_spans(), "partitioned gossip still delivered"
+        assert fleet.request_at(other, data, hi) == want  # via forward
+        # Heal: the next full sync must converge the stale replica.
+        CHAOS.heal(f"gossip-{home}")
+        assert _wait(b_has_spans, timeout=10.0), "no convergence after heal"
+
+        def covered():
+            with rep_b.lock:
+                best, gaps = rep_b.spans.cover(data, want[1], hi)
+                return best is not None and not gaps
+
+        assert _wait(covered, timeout=10.0)
+        assigned = METRICS.get("sched.chunks_assigned")
+        got = fleet.request_at_fed_port(other, data, hi, lower=want[1])
+        assert got == min_hash_range(data, want[1], hi)
+        assert METRICS.get("sched.chunks_assigned") == assigned
+    finally:
+        fleet.close()
+
+
+def test_local_fallback_when_all_peers_unreachable():
+    """A replica whose every peer is gone serves non-home data itself:
+    correct everywhere beats routed nowhere."""
+    METRICS.reset()
+    # A one-replica "federation" with a configured-but-never-started peer.
+    dead_port = random_port() + 177
+    rep = Replica(
+        "solo",
+        {"ghost": ("127.0.0.1", dead_port)},
+        params=PARAMS,
+        scheduler=Scheduler(min_chunk=500),
+        gossip_interval=5.0,
+        tick_interval=0.05,
+        peer_down_ttl=0.1,
+    ).start()
+    mc = lsp.Client("127.0.0.1", rep.port, PARAMS)
+    threading.Thread(
+        target=miner_mod.run_miner,
+        args=(mc, miner_mod.make_search("cpu")),
+        daemon=True,
+    ).start()
+    try:
+        data = next(
+            f"fb{i}" for i in range(64)
+            if Ring(["solo", "ghost"]).home(f"fb{i}") == "ghost"
+        )
+        want = min_hash_range(data, 0, 2000)
+        c = lsp.Client("127.0.0.1", rep.port, PARAMS)
+        try:
+            got = client_mod.request_once(c, data, 2000)
+        finally:
+            c.close()
+        assert got == want
+        assert METRICS.get("federation.local_fallbacks") >= 1
+    finally:
+        rep.close()
+
+
+@pytest.mark.analysis
+def test_federation_green_under_race_sanitizer(monkeypatch):
+    """The shared-event-lock discipline across serve loop, federation
+    ingest, forwarders and gossip, under the runtime race sanitizer."""
+    from bitcoin_miner_tpu.utils import sanitize
+
+    monkeypatch.setenv("BMT_SANITIZE", "1")
+    assert sanitize.enabled()
+    METRICS.reset()
+    fleet = FedFleet(n=2)
+    try:
+        out = {}
+        sigs = [("sanfed-a", 2000), ("sanfed-b", 2500)]
+        want = {d: min_hash_range(d, 0, mx) for d, mx in sigs}
+
+        def one(i):
+            d, mx = sigs[i % 2]
+            nm = list(fleet.replicas)[i % 2]
+            out[i] = (d, fleet.request_at(nm, d, mx))
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "client starved under sanitizer"
+        for i, (d, got) in out.items():
+            assert got == want[d], f"client {i}"
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------- fed-port local semantics
+
+
+def test_fed_port_never_forwards():
+    """Loop-freedom's foundation: a request at the federation port is
+    served locally even when the data's home is another replica."""
+    METRICS.reset()
+    fleet = FedFleet(n=2)
+    try:
+        data = next(
+            f"loop{i}" for i in range(64)
+            if fleet.ring().home(f"loop{i}") == "r1"
+        )
+        want = min_hash_range(data, 0, 1500)
+        forwarded = METRICS.get("federation.forwarded")
+        # Queried at r0's FED port although r1 is home: r0 must sweep it
+        # itself, not forward.
+        got = fleet.request_at_fed_port("r0", data, 1500)
+        assert got == want
+        assert METRICS.get("federation.forwarded") == forwarded
+    finally:
+        fleet.close()

@@ -793,3 +793,117 @@ def test_acceptance_drill_clean_run_stays_quiet():
     assert alerts == set(), (alerts, state and state.get("slo"))
     assert stragglers == set(), stragglers
     assert METRICS.get("slo.alerts_fired") == fired0
+
+
+# --------------------------------------------------------------------------
+# Federation view: per-cell fleet views merged into one (ISSUE 8 satellite)
+# --------------------------------------------------------------------------
+
+
+class TestFederationView:
+    """Two cells' hub views folded into one federation FleetView via
+    export_sources/ingest_cell: counters must not regress or double
+    count, sources keep per-cell identity, and the straggler detector
+    still names the right miner."""
+
+    def _cell(self, sources, now=0.0):
+        fv = FleetView(staleness_s=10.0, clock=lambda: 0.0)
+        for name, counters, hist_samples in sources:
+            state = {"seq": 1, "counters": counters}
+            if hist_samples is not None:
+                state["hists"] = {
+                    "hist.miner_chunk_s": _hist_of(hist_samples).state()
+                }
+            fv.ingest(name, state, now=now)
+        return fv
+
+    def test_counters_sum_once_across_cells(self):
+        a = self._cell([("m1", {"miner.nonces": 100}, None),
+                        ("m2", {"miner.nonces": 50}, None)])
+        b = self._cell([("m3", {"miner.nonces": 7}, None)])
+        fed = FleetView(staleness_s=10.0, clock=lambda: 0.0)
+        assert fed.ingest_cell("cellA", a.export_sources(now=0.0), now=0.0) == 2
+        assert fed.ingest_cell("cellB", b.export_sources(now=0.0), now=0.0) == 1
+        merged = fed.merged(now=0.0)
+        assert merged["counters"]["miner.nonces"] == 157
+        assert merged["sources"] == 3
+
+    def test_reingest_does_not_double_count(self):
+        a = self._cell([("m1", {"miner.nonces": 100}, None)])
+        fed = FleetView(staleness_s=10.0, clock=lambda: 0.0)
+        export = a.export_sources(now=0.0)
+        fed.ingest_cell("cellA", export, now=0.0)
+        fed.ingest_cell("cellA", export, now=0.0)  # a republished export
+        merged = fed.merged(now=0.0)
+        assert merged["counters"]["miner.nonces"] == 100  # not 200
+        assert merged["sources"] == 1
+
+    def test_same_miner_name_in_two_cells_stays_distinct(self):
+        a = self._cell([("m1", {"miner.nonces": 10}, None)])
+        b = self._cell([("m1", {"miner.nonces": 5}, None)])
+        fed = FleetView(staleness_s=10.0, clock=lambda: 0.0)
+        fed.ingest_cell("cellA", a.export_sources(now=0.0), now=0.0)
+        fed.ingest_cell("cellB", b.export_sources(now=0.0), now=0.0)
+        merged = fed.merged(now=0.0)
+        # Two sources, both contributions counted — the cell prefix is
+        # what makes the collision impossible.
+        assert merged["sources"] == 2
+        assert merged["counters"]["miner.nonces"] == 15
+        assert set(fed.sources(now=0.0)) == {"cellA/m1", "cellB/m1"}
+
+    def test_staleness_carries_across_the_cell_boundary(self):
+        a = self._cell([("fresh", {"n": 1}, None)])
+        a.ingest("stale", {"seq": 1, "counters": {"n": 1}}, now=-30.0)
+        fed = FleetView(staleness_s=10.0, clock=lambda: 0.0)
+        fed.ingest_cell("cellA", a.export_sources(now=0.0), now=0.0)
+        src = fed.sources(now=0.0)
+        assert src["cellA/fresh"]["stale"] is False
+        assert src["cellA/stale"]["stale"] is True
+        merged = fed.merged(now=0.0)
+        assert merged["sources"] == 1 and merged["stale_sources"] == 1
+
+    def test_straggler_detection_names_the_right_cell_miner(self):
+        fast = [0.01 * (1 + 0.1 * (i % 3)) for i in range(20)]
+        slow = [0.4 * (1 + 0.1 * (i % 3)) for i in range(20)]
+        a = self._cell([("m0", {}, fast), ("m1", {}, fast)])
+        b = self._cell([("m0", {}, fast), ("slowpoke", {}, slow)])
+        fed = FleetView(staleness_s=10.0, clock=lambda: 0.0)
+        fed.ingest_cell("cellA", a.export_sources(now=0.0), now=0.0)
+        fed.ingest_cell("cellB", b.export_sources(now=0.0), now=0.0)
+        out = fed.stragglers(now=0.0)
+        assert [s["source"] for s in out] == ["cellB/slowpoke"]
+
+    def test_dash_cells_frame_merges_for_display(self):
+        """tools/dash.py --cells: per-cell merged states render as one
+        federation frame — counters summed, sources/stragglers prefixed."""
+        import sys
+        from pathlib import Path
+
+        sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+        from tools.dash import merge_cell_states, render_frame
+
+        state_a = {
+            "sources": 2, "stale_sources": 0,
+            "per_source": {"m1": {"age_s": 1.0, "stale": False}},
+            "counters": {"sched.jobs_completed": 3},
+            "hists": {"hist.request_s": {"count": 2, "p50": 0.1,
+                                         "p95": 0.2, "p99": 0.2}},
+            "stragglers": [{"source": "m1", "p50_s": 0.4,
+                            "fleet_p50_s": 0.01, "ratio": 40.0}],
+        }
+        state_b = {
+            "sources": 1, "stale_sources": 1,
+            "per_source": {"m1": {"age_s": 2.0, "stale": False}},
+            "counters": {"sched.jobs_completed": 4},
+            "slo": {"slos": [{"name": "req_p95", "burn_fast": 9.0,
+                              "burn_slow": 8.0, "ok": False,
+                              "firing": True}], "alerts": ["req_p95"]},
+        }
+        merged = merge_cell_states({"cellA": state_a, "cellB": state_b})
+        assert merged["sources"] == 3 and merged["stale_sources"] == 1
+        assert merged["counters"]["sched.jobs_completed"] == 7
+        assert set(merged["per_source"]) == {"cellA/m1", "cellB/m1"}
+        assert merged["stragglers"][0]["source"] == "cellA/m1"
+        assert merged["slo"]["alerts"] == ["cellB/req_p95"]
+        frame = render_frame(merged)
+        assert "cellA/m1" in frame and "cellB/req_p95" in frame

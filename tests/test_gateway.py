@@ -393,20 +393,45 @@ class TestIntervalServing:
     def test_queued_request_replans_at_admit_time(self):
         """Spans solved while a request waits in the admission queue are
         visible at dispatch: a fully covered twin resolves from the queue
-        with no slot at all."""
+        with no slot at all.  (A DIFFERENT data key, so the request
+        queues on the full slot rather than span-wait-parking on the
+        in-flight sweep — parking is TestInflightSpanWait's subject.)"""
         METRICS.reset()
         g = make_gateway(max_active=1,
                          sched={"min_chunk": 300, "max_chunk": 300,
                                 "validate_results": False})
         g.miner_joined(1, now=0.0)
         g.client_request(10, DATA, 0, 299, now=0.0)
-        g.client_request(11, DATA, 100, 200, now=0.1)  # queued: slot full
+        g.client_request(11, "otherdata", 100, 200, now=0.1)  # queued: slot full
         assert g.stats()["gw_queued"] == 1
-        acts = g.result(1, hash_=700, nonce=150, now=1.0)
-        # The completion both answers 10 AND resolves 11 from the queue
-        # via the freshly recorded span (argmin 150 inside [100,200]).
-        assert sorted(cid for cid, _ in results(acts)) == [10, 11]
+        g.result(1, hash_=700, nonce=150, now=1.0)
+        # 10 answered; 11 admitted into the freed slot and sweeping.
+        g.miner_joined(2, now=1.1)
+        acts = g.client_request(12, "otherdata", 100, 200, now=1.2)
+        assert acts == []  # coalesced into 11's now-running sweep
         assert g.stats()["gw_queued"] == 0
+        done = results(g.result(1, hash_=500, nonce=170, now=2.0))
+        assert sorted(cid for cid, _ in done) == [11, 12]
+        # Full-coverage replan FROM THE QUEUE: a request overlapping but
+        # not inside the running sweep (so it queues, not parks) becomes
+        # fully covered by the completion's span + an older span, and
+        # resolves at admit time with zero chunks.
+        METRICS.reset()
+        g2 = make_gateway(max_active=1,
+                          sched={"min_chunk": 300, "max_chunk": 300,
+                                 "validate_results": False})
+        g2.miner_joined(1, now=0.0)
+        g2.client_request(9, DATA, 300, 500, now=0.0)
+        g2.result(1, hash_=300, nonce=400, now=0.5)  # span [300,500]@400
+        g2.client_request(10, DATA, 0, 299, now=0.6)
+        # [250,450] is NOT inside [0,299]: it queues on the full slot.
+        g2.client_request(11, DATA, 250, 450, now=0.7)
+        assert g2.stats()["gw_queued"] == 1
+        acts = g2.result(1, hash_=700, nonce=270, now=1.0)
+        # Completion answers 10 AND resolves 11 from the queue: spans
+        # [0,299]@270 + [300,500]@400 fully cover [250,450].
+        assert sorted(cid for cid, _ in results(acts)) == [10, 11]
+        assert g2.stats()["gw_queued"] == 0
         assert METRICS.get("gateway.span_hits") == 1
 
     def test_spans_disabled_gateway_still_correct(self):
@@ -435,6 +460,126 @@ class TestIntervalServing:
         [j] = [j for j in state["jobs"] if (j["lower"], j["upper"]) == (0, 499)]
         assert j["best"] == [600, 150]  # the span seed survived the stash
         assert j["remaining"] == [[400, 499]]
+
+
+class TestInflightSpanWait:
+    """Span-aware coalescing of IN-FLIGHT jobs (ISSUE 8 satellite): a
+    sub-range request fully inside a currently-running sweep parks on
+    that sweep's completion instead of re-sweeping the overlap, then
+    replans against the freshly recorded chunk spans."""
+
+    def _gateway(self, **kw):
+        return make_gateway(sched={"min_chunk": 100, "max_chunk": 100,
+                                   "validate_results": False}, **kw)
+
+    def test_subrange_parks_then_answers_with_zero_extra_chunks(self):
+        METRICS.reset()
+        g = self._gateway()
+        g.miner_joined(1, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        assigned = METRICS.get("sched.chunks_assigned")
+        # Fully inside the running sweep: parks, no new chunks, no queue.
+        assert g.client_request(20, DATA, 50, 249, now=0.1) == []
+        assert METRICS.get("gateway.inflight_span_waits") == 1
+        assert METRICS.get("sched.chunks_assigned") == assigned
+        assert g.stats()["gw_span_waits"] == 1
+        g.result(1, hash_=700, nonce=50, now=1.0)
+        g.result(1, hash_=600, nonce=150, now=2.0)
+        acts = g.result(1, hash_=650, nonce=210, now=3.0)
+        # The completion answers BOTH: 10 with the full range's min, 20
+        # from the chunk spans (every boundary argmin inside [50,249]).
+        done = dict(results(acts))
+        assert (done[10].hash, done[10].nonce) == (600, 150)
+        assert (done[20].hash, done[20].nonce) == (600, 150)
+        # Every chunk assigned belonged to the ONE super sweep (3×100);
+        # the parked request cost zero device work of its own.
+        assert METRICS.get("sched.chunks_assigned") == 3
+        assert g.stats()["gw_span_waits"] == 0
+
+    def test_release_sweeps_only_unanswerable_sliver(self):
+        """A boundary chunk whose argmin falls OUTSIDE the parked range
+        cannot answer its portion: the release submits just that sliver,
+        seeded with the answered portions' fold."""
+        METRICS.reset()
+        g = self._gateway()
+        g.miner_joined(1, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        assert g.client_request(20, DATA, 50, 249, now=0.1) == []
+        g.result(1, hash_=700, nonce=50, now=1.0)
+        g.result(1, hash_=600, nonce=150, now=2.0)
+        # Last chunk's argmin (290) is outside [50,249]: its [200,249]
+        # portion is not answerable and must sweep.
+        acts = g.result(1, hash_=650, nonce=290, now=3.0)
+        done = dict(results(acts))
+        assert 10 in done and 20 not in done
+        req = requests(acts)
+        assert [(m.lower, m.upper) for _, m in req] == [(200, 249)]
+        assert METRICS.get("gateway.span_partial") == 1
+        done2 = dict(results(g.result(1, hash_=660, nonce=230, now=4.0)))
+        assert (done2[20].hash, done2[20].nonce) == (600, 150)
+
+    def test_parked_waiter_death_leaves_sweep_alone(self):
+        METRICS.reset()
+        g = self._gateway()
+        g.miner_joined(1, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        g.client_request(20, DATA, 50, 249, now=0.1)
+        assert g.lost(20, now=0.5) == []
+        assert g.stats()["gw_span_waits"] == 0
+        g.result(1, hash_=700, nonce=50, now=1.0)
+        g.result(1, hash_=600, nonce=150, now=2.0)
+        acts = g.result(1, hash_=650, nonce=210, now=3.0)
+        assert [cid for cid, _ in results(acts)] == [10]  # sweep unharmed
+
+    def test_cancelled_sweep_resubmits_parked_waiters(self):
+        """The covering sweep's last primary waiter dies: the sweep
+        cancels into the orphan stash, and each parked sub-range request
+        is replanned as its own job — the chunks the sweep DID finish
+        answer as spans, only the rest sweeps."""
+        METRICS.reset()
+        g = self._gateway()
+        g.miner_joined(1, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        g.client_request(20, DATA, 50, 249, now=0.1)
+        g.result(1, hash_=700, nonce=50, now=1.0)  # [0,99] solved
+        g.lost(10, now=2.0)  # last primary waiter gone: cancel
+        # 20 was resubmitted as its own job: [50,99] answers from the
+        # solved chunk's span, only [100,249] needs sweeping.  The miner
+        # is still draining the DEAD sweep's pipelined chunks, so the new
+        # job's dispatches ride the next completions.
+        assert g.stats()["gw_span_waits"] == 0
+        assert g.stats()["jobs"] == 1  # the resubmitted remainder job
+        acts = g.result(1, hash_=600, nonce=150, now=3.0)  # dead [100,199]
+        req = requests(acts)
+        assert req and all(100 <= m.lower and m.upper <= 249 for _, m in req)
+        done = {}
+        # FIFO: the dead sweep's second pipelined chunk drains first
+        # (discarded — no job), then the remainder job's two chunks.
+        for h, n, t in ((610, 260, 4.0), (620, 170, 5.0), (660, 230, 6.0)):
+            done.update(dict(results(g.result(1, hash_=h, nonce=n, now=t))))
+        assert (done[20].hash, done[20].nonce) == (620, 170)
+
+    def test_no_parking_with_spans_disabled(self):
+        """Without the interval store the wait would end in a full
+        re-sweep anyway: the request runs as its own job immediately."""
+        METRICS.reset()
+        g = make_gateway(spans=SpanStore(capacity=0),
+                         sched={"min_chunk": 100, "max_chunk": 100,
+                                "validate_results": False})
+        g.miner_joined(1, now=0.0)
+        g.miner_joined(2, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        acts = g.client_request(20, DATA, 50, 249, now=0.1)
+        assert requests(acts)  # its own sweep, right now
+        assert METRICS.get("gateway.inflight_span_waits") == 0
+
+    def test_parked_conn_is_one_job_like_everyone(self):
+        g = self._gateway()
+        g.miner_joined(1, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        g.client_request(20, DATA, 50, 249, now=0.1)
+        assert g.client_request(20, "other", 0, 99, now=0.2) == []
+        assert g.miner_joined(20, now=0.3) == []  # role confusion refused
 
 
 class TestAdmission:

@@ -30,6 +30,8 @@ EXTERNAL_CLASSES = (
     ("bitcoin_miner_tpu/gateway/admission.py", "TokenBucket"),
     ("bitcoin_miner_tpu/utils/wfq.py", "VirtualClockWFQ"),
     ("bitcoin_miner_tpu/utils/intervals.py", "IntervalMap"),
+    ("bitcoin_miner_tpu/federation/gossip.py", "GossipSpanStore"),
+    ("bitcoin_miner_tpu/federation/ring.py", "Ring"),
 )
 
 #: Internally-locked classes expected to carry ``# guarded-by:`` field
@@ -45,6 +47,7 @@ INTERNAL_CLASSES = (
     ("bitcoin_miner_tpu/utils/fleetview.py", "FleetView"),
     ("bitcoin_miner_tpu/utils/slo.py", "SloEngine"),
     ("bitcoin_miner_tpu/utils/telemetry.py", "TelemetryHub"),
+    ("bitcoin_miner_tpu/federation/replica.py", "Replica"),
 )
 
 #: Functions whose locals carry ``# guarded-by: <lockvar>`` annotations

@@ -9,6 +9,14 @@ channel itself (subscribe mode):
     python -m tools.dash fleet.jsonl --follow   # tail the file live
     python -m tools.dash --connect HOST:PORT    # subscribe to the hub
     python -m tools.dash fleet.jsonl --once     # one frame, no ANSI
+    python -m tools.dash --cells a=a.jsonl,b=b.jsonl   # federation view
+
+``--cells`` is the federation mode (ISSUE 8): each cell's fleet log is
+one replica's merged view; the frame shows them folded into ONE
+federation view — per-source rows prefixed ``cell/``, counters summed
+across cells (each miner exports to exactly one cell's hub, so cell
+sums never double-count a source), stragglers and SLO alerts unioned
+with their cell names.
 
 One frame shows: source liveness (fresh/stale with ages), the SLO table
 (burn rates fast/slow, firing state), flagged stragglers, the merged
@@ -100,6 +108,49 @@ def render_frame(state: dict, width: int = 78) -> str:
     return "\n".join(lines)
 
 
+def merge_cell_states(cells: dict) -> dict:
+    """Fold per-cell merged states ({cell: state}) into one federation
+    display state (the ``--cells`` frame).  Counters sum across cells;
+    per-source rows, stragglers and firing SLOs carry a ``cell/`` prefix;
+    histograms keep per-cell resolution under prefixed names (snapshot
+    dicts carry quantiles, not buckets, so re-merging them numerically
+    would fabricate data — prefixing shows the truth instead)."""
+    out: dict = {
+        "sources": 0,
+        "stale_sources": 0,
+        "per_source": {},
+        "counters": {},
+        "hists": {},
+        "stragglers": [],
+    }
+    slos: List[dict] = []
+    for cell in sorted(cells):
+        state = cells[cell]
+        if not isinstance(state, dict):
+            continue
+        out["sources"] += state.get("sources", 0)
+        out["stale_sources"] += state.get("stale_sources", 0)
+        for name, info in (state.get("per_source") or {}).items():
+            out["per_source"][f"{cell}/{name}"] = info
+        for k, v in (state.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                out["counters"][k] = out["counters"].get(k, 0) + v
+        for k, s in (state.get("hists") or {}).items():
+            out["hists"][f"{cell}/{k}"] = s
+        for s in state.get("stragglers") or []:
+            out["stragglers"].append({**s, "source": f"{cell}/{s['source']}"})
+        slo = state.get("slo")
+        if slo:
+            for s in slo.get("slos", []):
+                slos.append({**s, "name": f"{cell}/{s['name']}"})
+    if slos:
+        out["slo"] = {
+            "slos": slos,
+            "alerts": [s["name"] for s in slos if s.get("firing")],
+        }
+    return out
+
+
 # ------------------------------------------------------------------- inputs
 
 def _states_from_file(path: str, follow: bool, poll_s: float) -> Iterator[dict]:
@@ -179,6 +230,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="fleet-log JSONL file (server --fleet-log=FILE)")
     ap.add_argument("--connect", metavar="HOST:PORT", default=None,
                     help="subscribe to a live server's --telemetry-port")
+    ap.add_argument("--cells", metavar="NAME=FILE[,NAME=FILE...]",
+                    default=None,
+                    help="federation view: merge several cells' fleet "
+                         "logs into one frame (ISSUE 8)")
     ap.add_argument("--follow", action="store_true",
                     help="keep tailing the file (connect mode always follows)")
     ap.add_argument("--interval", type=float, default=1.0,
@@ -186,6 +241,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--once", action="store_true",
                     help="render one frame without ANSI clears and exit")
     args = ap.parse_args(argv)
+    if args.cells is not None:
+        if args.file is not None or args.connect is not None:
+            ap.error("--cells replaces FILE/--connect")
+        cells = {}
+        missing = []
+        for part in args.cells.split(","):
+            name, sep, path = part.partition("=")
+            if not sep or not name or not path:
+                ap.error(f"--cells entry {part!r} is not NAME=FILE")
+            try:
+                states = list(_states_from_file(path, follow=False, poll_s=0))
+            except SystemExit:
+                states = []
+            if states:
+                cells[name] = states[-1]
+            else:
+                missing.append(f"{name} ({path})")
+        if missing:
+            # A federation frame silently missing a replica is exactly the
+            # failure this dashboard exists to surface: name the holes.
+            print(
+                "dash: no fleet state for cell(s): " + ", ".join(missing),
+                file=sys.stderr,
+            )
+        if not cells:
+            print("no fleet states found", file=sys.stderr)
+            return 1
+        print(render_frame(merge_cell_states(cells)))
+        return 0
     if (args.file is None) == (args.connect is None):
         ap.error("give a fleet-log FILE or --connect HOST:PORT (not both)")
 

@@ -246,6 +246,305 @@ def run_job(
     }
 
 
+def _wait_replica(proc: subprocess.Popen, timeout: float) -> None:
+    import select
+
+    deadline = time.monotonic() + timeout
+    line = ""
+    while time.monotonic() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+        if ready:
+            line = proc.stdout.readline()
+            if "listening on port" in line:
+                return
+        if proc.poll() is not None:
+            break
+    raise RuntimeError(f"replica did not come up (last: {line!r})")
+
+
+class _FedCell:
+    """One subprocess replica + its cpu-miner subprocess."""
+
+    def __init__(self, name, port, fed_port, peers_spec, tmp):
+        self.name, self.port, self.fed_port = name, port, fed_port
+        argv = [
+            sys.executable, "-m", "bitcoin_miner_tpu.apps.federation",
+            str(port), f"--cell={name}", f"--fed-port={fed_port}",
+            "--gossip-interval=0.3",
+        ]
+        if peers_spec:
+            argv.append(f"--peers={peers_spec}")
+        self.proc = subprocess.Popen(
+            argv,
+            cwd=tmp,
+            env={**os.environ, "PYTHONPATH": str(REPO)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        _wait_replica(self.proc, 30)
+        self._mlog = open(os.path.join(tmp, f"miner.{name}.log"), "wb")
+        self.miner = subprocess.Popen(
+            [
+                sys.executable, "-m", "bitcoin_miner_tpu.apps.miner",
+                f"127.0.0.1:{port}", "--backend", "cpu",
+            ],
+            cwd=str(REPO),
+            stdout=subprocess.DEVNULL,
+            stderr=self._mlog,
+        )
+
+    def alive(self):
+        return self.proc.poll() is None
+
+    def kill(self, miner_only=False):
+        if self.miner.poll() is None:
+            self.miner.send_signal(signal.SIGKILL)
+        if not miner_only and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+
+
+def _fed_request_once(port, data, lo, hi, deadline_s=30.0):
+    """One wire request with a hard deadline (a minerless cell that would
+    have to sweep hangs instead of answering — the black-box zero-work
+    discriminator the probes rely on)."""
+    from bitcoin_miner_tpu import lsp
+
+    try:
+        c = lsp.Client("127.0.0.1", port)
+    except (lsp.LspError, OSError):
+        return None
+    got = None
+    try:
+        c.write(Message.request(data, lo, hi).marshal())
+        box: list = []
+
+        def _read() -> None:
+            try:
+                box.append(c.read())
+            except BaseException as e:
+                box.append(e)
+
+        rt = threading.Thread(target=_read, daemon=True)
+        rt.start()
+        rt.join(timeout=deadline_s)
+        if box and not isinstance(box[0], BaseException):
+            m = Message.unmarshal(box[0])
+            if m is not None and m.type == MsgType.RESULT:
+                got = (m.hash, m.nonce)
+    finally:
+        try:
+            c.close()
+        except lsp.LspError:
+            pass
+    return got
+
+
+def _fed_batch(cells, jobs, oracle, clients=6, deadline_s=120.0,
+               on_index=None):
+    """Spray the jobs across the cells' public ports from ``clients``
+    worker threads (round-robin start + failover to the next live cell),
+    validating every Result against the oracle.  Returns wall seconds."""
+    errors: list = []
+    cursor = [0]
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                if cursor[0] >= len(jobs) or errors:
+                    return
+                i = cursor[0]
+                cursor[0] += 1
+            if on_index is not None:
+                on_index(i)
+            data, lo, hi = jobs[i]
+            got = None
+            live = [c for c in cells if c.alive()]
+            for k in range(len(live)):
+                cell = live[(i + k) % len(live)]
+                got = _fed_request_once(cell.port, data, lo, hi, deadline_s)
+                if got is not None:
+                    break
+            if got != oracle[(data, lo, hi)]:
+                errors.append(f"job {i} ({data},{lo},{hi}): got {got}")
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=deadline_s * len(jobs))
+    wall = time.monotonic() - t0
+    if errors:
+        raise RuntimeError("federation batch failed: " + "; ".join(errors[:5]))
+    return wall
+
+
+def run_federation_bench(args) -> int:
+    """The federation leg (ISSUE 8), all real subprocesses — N
+    ``apps.federation`` replicas each with its own cpu-miner process, so
+    (unlike an in-process harness) the cells genuinely compute in
+    parallel:
+
+    1. a timed sweep-bound duplicate-heavy batch across N replicas, then
+       the SAME batch on a fresh 1-replica federation — the 1→N jobs/s
+       scaling number (BENCH_pr8.json);
+    2. an untimed drill batch with one whole cell SIGKILLed mid-batch:
+       every Result still oracle-bit-exact through the survivors;
+    3. black-box zero-work probes: every miner is killed, then a repeat
+       of a solved signature must answer at EVERY live replica (cache via
+       routing) and a never-issued covered sub-range must answer at a
+       NON-home replica's federation port (gossiped spans) — a cell that
+       had to sweep would hang its minerless scheduler past the deadline.
+
+    Prints one JSON line."""
+    import random
+
+    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+    from bitcoin_miner_tpu.federation.ring import Ring
+
+    n = max(2, args.federation)
+    names = [f"r{i}" for i in range(n)]
+    base = args.port or 3000 + (os.getpid() * 7919) % 40000
+    tmp = tempfile.mkdtemp(prefix="fed_bench_")
+    rng = random.Random(7)
+    # Sweep-bound duplicate-heavy jobs: ~1e6-nonce ranges keep each cpu
+    # miner busy seconds per distinct signature, so the scaling number
+    # measures the cells' parallel sweep capacity, not client overhead.
+    n_jobs, max_nonce = 36, 1_000_000
+    issued: list = []
+    jobs: list = []
+    for _ in range(n_jobs):
+        if issued and rng.random() < 0.35:
+            jobs.append(rng.choice(issued))
+        else:
+            sig = (f"fed{len(issued)}", 0,
+                   rng.randint(max_nonce // 2, max_nonce))
+            issued.append(sig)
+            jobs.append(sig)
+    oracle = {s: min_hash_range(s[0], s[1], s[2]) for s in set(jobs)}
+    log(f"workload: {len(jobs)} jobs, {len(issued)} distinct, "
+        f"max_nonce {max_nonce}")
+
+    cells: list = []
+    single: list = []
+    try:
+        for i, name in enumerate(names):
+            peers = ",".join(
+                f"{o}=127.0.0.1:{base + 2 * j + 1}"
+                for j, o in enumerate(names) if o != name
+            )
+            cells.append(
+                _FedCell(name, base + 2 * i, base + 2 * i + 1, peers, tmp)
+            )
+        log(f"federation up: {n} replicas on {[c.port for c in cells]}")
+        wall_n = _fed_batch(cells, jobs, oracle)
+        rate_n = len(jobs) / wall_n
+        log(f"{n}-replica leg: {rate_n:.2f} jobs/s over {wall_n:.2f}s")
+
+        # The 1-replica comparison: a fresh single cell, fresh data keys
+        # (same shapes) so nothing is pre-solved.
+        sjobs = [(f"s{d[3:]}" if d.startswith("fed") else d, lo, hi)
+                 for d, lo, hi in jobs]
+        soracle = {s: min_hash_range(s[0], s[1], s[2]) for s in set(sjobs)}
+        single.append(_FedCell("solo", base + 100, base + 101, "", tmp))
+        wall_1 = _fed_batch(single, sjobs, soracle)
+        rate_1 = len(sjobs) / wall_1
+        log(f"1-replica leg: {rate_1:.2f} jobs/s over {wall_1:.2f}s "
+            f"(scaling {rate_n / rate_1:.2f}x)")
+
+        # Cell-kill drill: fresh keys, one whole cell SIGKILLed mid-batch.
+        ring = Ring(names)
+        wide = max(jobs, key=lambda s: s[2] - s[1])
+        probe_name = next(nm for nm in names if nm != ring.home(wide[0]))
+        victim = next(nm for nm in names if nm != probe_name)
+        vcell = next(c for c in cells if c.name == victim)
+        djobs = [(f"k{i}", 0, 400_000) for i in range(6)]
+        doracle = {s: min_hash_range(s[0], s[1], s[2]) for s in set(djobs)}
+        kill_at = len(djobs) // 2
+        fired = [False]
+
+        def maybe_kill(i):
+            if i >= kill_at and not fired[0]:
+                fired[0] = True
+                log(f"cell-kill drill: SIGKILL cell {victim} mid-batch")
+                vcell.kill()
+
+        _fed_batch(cells, djobs, doracle, on_index=maybe_kill)
+        log(f"cell-kill drill: all {len(djobs)} Results bit-exact "
+            f"through the survivors")
+
+        # Zero-work probes: no miner anywhere — an answer now can only
+        # come from caches/spans; a sweep would hang past the deadline.
+        time.sleep(2.0)  # let gossip full-sync the batch's spans
+        for c in cells:
+            c.kill(miner_only=True)
+        time.sleep(0.5)
+        repeat_ok = True
+        # A signature homed on a LIVE cell: the probe proves the routing +
+        # cache path, not dead-home failover (the drill above covered
+        # that); a victim-homed key would burn the deadline on connect
+        # timeouts to the killed cell.
+        data, lo, hi = next(
+            s for s in jobs if ring.home(s[0]) != victim
+        )
+        for c in cells:
+            if not c.alive():
+                continue
+            got = _fed_request_once(c.port, data, lo, hi, deadline_s=10.0)
+            ok = got == oracle[(data, lo, hi)]
+            log(f"repeat probe at {c.name} (minerless): {ok}")
+            repeat_ok = repeat_ok and ok
+        h_star, n_star = oracle[wide]
+        probe_cell = next(c for c in cells if c.name == probe_name)
+        gossip_ok = None
+        if n_star > wide[1] and probe_cell.alive():
+            want = min_hash_range(wide[0], n_star, wide[2])
+            got = _fed_request_once(
+                probe_cell.fed_port, wide[0], n_star, wide[2],
+                deadline_s=10.0,
+            )
+            gossip_ok = got == want
+            log(f"gossip probe at {probe_name}'s fed port (minerless): "
+                f"got {got}, want {want} -> {gossip_ok}")
+        if not repeat_ok or gossip_ok is False:
+            raise RuntimeError(
+                f"zero-work probes failed: repeat={repeat_ok} "
+                f"gossip={gossip_ok}"
+            )
+        print(
+            json.dumps(
+                {
+                    "metric": "federation_fleet_jobs_per_sec",
+                    "value": round(rate_n, 3),
+                    "unit": "jobs/s",
+                    "replicas": n,
+                    # Scaling is bounded by the host: N cells can only
+                    # compute in parallel up to the core count.
+                    "host_cpus": os.cpu_count(),
+                    "jobs": len(jobs),
+                    "distinct_signatures": len(issued),
+                    "max_nonce": max_nonce,
+                    "wall_s": round(wall_n, 3),
+                    "single_jobs_per_sec": round(rate_1, 3),
+                    "single_wall_s": round(wall_1, 3),
+                    "scaling_vs_single": round(rate_n / rate_1, 3),
+                    "cell_killed_mid_batch": victim,
+                    "kill_drill_bit_exact": True,
+                    "repeat_zero_work_all_replicas": repeat_ok,
+                    "cross_replica_zero_work_probe": gossip_ok,
+                }
+            ),
+            flush=True,
+        )
+        return 0
+    finally:
+        for c in cells + single:
+            c.kill()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nonces", type=int, default=2 * 10**10)
@@ -323,7 +622,20 @@ def main() -> int:
         "are stamped into the JSON line (watch live: python -m tools.dash "
         "--connect)",
     )
+    ap.add_argument(
+        "--federation",
+        type=int,
+        default=0,
+        metavar="N",
+        help="federation leg (ISSUE 8): N real apps.federation replica "
+        "subprocesses, duplicate-heavy batch with a mid-batch cell "
+        "SIGKILL and a minerless cross-replica gossip probe; prints its "
+        "own JSON line and exits",
+    )
     args = ap.parse_args()
+
+    if args.federation:
+        return run_federation_bench(args)
 
     port = args.port or 3000 + (os.getpid() * 7919) % 50000
     data = "cmu440"

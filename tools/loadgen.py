@@ -76,15 +76,16 @@ def build_workload(args) -> list:
     return jobs
 
 
-def build_overlap_workload(args) -> list:
+def build_overlap_workload(args, n_datas: int = 3) -> list:
     """Overlap-heavy jobs for the interval store (ISSUE 5): a few shared
     data keys, each hit by growing prefixes ``[0, hi]`` (extensions sweep
     only the new tail), interior sub-ranges ``[lo, hi]`` (answered from
     chunk spans), and exact repeats (both stores should catch those) —
     the many-clients regime where ranges nest and overlap but rarely
-    repeat exactly."""
+    repeat exactly.  ``n_datas`` widens the key family (the federation
+    bench uses more keys so the ring has something to spread)."""
     rng = random.Random(args.seed)
-    datas = [f"ov{i}" for i in range(3)]
+    datas = [f"ov{i}" for i in range(n_datas)]
     issued: list = []
     jobs: list = []
     for _ in range(args.jobs):
@@ -305,6 +306,246 @@ def _subrange_probe(engine, server, params, jobs, errors):
     return ok
 
 
+def _free_udp_port() -> int:
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_federation_leg(n_replicas: int, jobs: list, args, oracle: dict) -> dict:
+    """Stand up ``n_replicas`` in-process federation cells (each with its
+    own scheduler + miners), spray the workload round-robin across the
+    replicas' PUBLIC ports — the load-balancer-spray regime consistent-
+    hash routing exists for — and fail a client over to the next replica
+    if its conn dies.  Returns timing + METRICS deltas + the federation
+    probes (ISSUE 8):
+
+    - ``repeat_zero_chunks``: a repeat of a solved signature submitted at
+      EVERY replica answers with zero new chunks anywhere (routing lands
+      it on the home's cache);
+    - ``cross_replica_zero_chunks``: after gossip, a never-issued
+      fully-covered sub-range queried at a NON-home replica's federation
+      port (the local-serve path) answers bit-exact with zero chunks —
+      a range solved anywhere answers everywhere;
+    - ``gossip_max_frame_bytes``: the largest gossip datagram written
+      (must respect the frozen 1000-byte wire ceiling with envelope
+      headroom)."""
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.apps import client as client_mod
+    from bitcoin_miner_tpu.apps import miner as miner_mod
+    from bitcoin_miner_tpu.apps.scheduler import Scheduler
+    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+    from bitcoin_miner_tpu.federation import Replica, Ring
+    from bitcoin_miner_tpu.utils.metrics import METRICS, Histogram
+
+    params = lsp.Params(epoch_limit=5, epoch_millis=200, window_size=5)
+    names = [f"r{i}" for i in range(n_replicas)]
+    fed_ports = {name: _free_udp_port() for name in names}
+    replicas = []
+    for name in names:
+        peers = {
+            other: ("127.0.0.1", fed_ports[other])
+            for other in names
+            if other != name
+        }
+        replicas.append(
+            Replica(
+                name,
+                peers,
+                fed_port=fed_ports[name],
+                params=params,
+                scheduler=Scheduler(min_chunk=args.min_chunk),
+                gossip_interval=0.2,
+                tick_interval=0.05,
+            ).start()
+        )
+    search = miner_mod.make_search("cpu")
+    for rep in replicas:
+        for _ in range(args.miners):
+            mc = lsp.Client("127.0.0.1", rep.port, params)
+            threading.Thread(
+                target=miner_mod.run_miner, args=(mc, search), daemon=True
+            ).start()
+
+    ports = [rep.port for rep in replicas]
+    before = METRICS.snapshot()
+    errors: list = []
+    cursor = [0]
+    cursor_lock = threading.Lock()
+    latency = Histogram()
+
+    def one_request(start_idx: int, data: str, lo: int, hi: int):
+        """Request with load-balancer failover: try each replica once."""
+        for k in range(len(ports)):
+            port = ports[(start_idx + k) % len(ports)]
+            try:
+                c = lsp.Client("127.0.0.1", port, params)
+            except (lsp.LspError, OSError):
+                continue
+            try:
+                got = client_mod.request_once(c, data, hi, lower=lo)
+            finally:
+                try:
+                    c.close()
+                except lsp.LspError:
+                    pass
+            if got is not None:
+                return got
+        return None
+
+    def worker(idx: int) -> None:
+        while True:
+            with cursor_lock:
+                if cursor[0] >= len(jobs):
+                    return
+                job_i = cursor[0]
+                cursor[0] += 1
+            data, lo, hi = jobs[job_i]
+            t_req = time.monotonic()
+            got = one_request(job_i, data, lo, hi)
+            latency.observe(time.monotonic() - t_req)
+            want = oracle[(data, lo, hi)]
+            if got != want:
+                errors.append(
+                    f"job {job_i} ({data},{lo},{hi}): got {got}, want {want}"
+                )
+                return
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(args.clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=args.timeout)
+        if t.is_alive():
+            errors.append(f"worker timed out after {args.timeout:.0f}s")
+    wall = time.monotonic() - t0
+
+    repeat_zero = cross_zero = None
+    if not errors:
+        # Repeat probe at EVERY replica: routing must land each repeat on
+        # the home cell's cache/spans — zero chunks assigned anywhere.
+        assigned = METRICS.get("sched.chunks_assigned")
+        data, lo, hi = jobs[0]
+        repeat_zero = True
+        for i in range(len(ports)):
+            got = one_request(i, data, lo, hi)
+            if got != oracle[(data, lo, hi)]:
+                errors.append(f"repeat probe at replica {i}: got {got}")
+                repeat_zero = False
+        if METRICS.get("sched.chunks_assigned") != assigned:
+            errors.append("repeat probe assigned chunks at some replica")
+            repeat_zero = False
+    if not errors and n_replicas > 1:
+        cross_zero = _cross_replica_probe(
+            replicas, params, jobs, oracle, errors, min_hash_range, Ring,
+            METRICS,
+        )
+
+    gossip_max = max(rep.gossip.max_frame_bytes for rep in replicas)
+    for rep in replicas:
+        rep.close()
+    after = METRICS.snapshot()
+    deltas = {
+        k: after[k] - before.get(k, 0)
+        for k in sorted(after)
+        if k.startswith(("gateway.", "sched.", "federation."))
+        and after[k] != before.get(k, 0)
+    }
+    if errors:
+        raise RuntimeError(
+            f"federation leg ({n_replicas} replicas) failed: "
+            + "; ".join(errors[:5])
+        )
+    lat = latency.snapshot()
+    return {
+        "wall_s": wall,
+        "jobs_per_sec": len(jobs) / wall if wall > 0 else 0.0,
+        "counters": deltas,
+        "repeat_zero_chunks": repeat_zero,
+        "cross_replica_zero_chunks": cross_zero,
+        "gossip_max_frame_bytes": gossip_max,
+        "latency_s": {
+            "p50": round(lat["p50"], 6),
+            "p95": round(lat["p95"], 6),
+            "p99": round(lat["p99"], 6),
+            "count": int(lat["count"]),
+        },
+    }
+
+
+def _cross_replica_probe(
+    replicas, params, jobs, oracle, errors, min_hash_range, Ring, METRICS
+):
+    """The ISSUE 8 acceptance probe: a never-issued sub-range of solved
+    work, fully covered BY GOSSIP on a replica that is NOT the data's
+    home, must answer bit-exact with zero chunks assigned — through that
+    replica's federation port (the local-serve path, so the answer
+    provably comes from the probed replica's own spans)."""
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.apps import client as client_mod
+
+    issued = {tuple(j) for j in jobs}
+    ring = Ring([rep.cell for rep in replicas])
+    by_name = {rep.cell: rep for rep in replicas}
+    # Widest signature whose home is identifiable; probe a DIFFERENT cell.
+    data, lo, hi = max(jobs, key=lambda s: s[2] - s[1])
+    home = ring.home(data)
+    probe_rep = next(rep for rep in replicas if rep.cell != home)
+    # Wait for gossip (delta beats + full syncs) to cover a candidate
+    # sub-range on the probed replica, built from its own span geometry.
+    deadline = time.monotonic() + 10.0
+    sub = None
+    while time.monotonic() < deadline and sub is None:
+        with probe_rep.lock:
+            span_map = probe_rep.spans._maps.get(data)
+            rows = span_map.spans() if span_map is not None else []
+            for s_lo, s_hi, _h, n in rows:
+                for cand in ((lo, s_hi), (lo, n), (n, hi)):
+                    qlo, qhi = cand
+                    if not (lo <= qlo <= qhi <= hi) or (qlo, qhi) == (lo, hi):
+                        continue
+                    if (data, qlo, qhi) in issued:
+                        continue
+                    best, gaps = probe_rep.spans.cover(data, qlo, qhi)
+                    if not gaps and best is not None:
+                        sub = (qlo, qhi)
+                        break
+                if sub is not None:
+                    break
+        if sub is None:
+            time.sleep(0.1)
+    if sub is None:
+        errors.append(
+            f"gossip never covered a probe sub-range of {data!r} on "
+            f"{probe_rep.cell} (home {home})"
+        )
+        return False
+    assigned = METRICS.get("sched.chunks_assigned")
+    c = lsp.Client("127.0.0.1", by_name[probe_rep.cell].fed_port, params)
+    try:
+        got = client_mod.request_once(c, data, sub[1], lower=sub[0])
+    finally:
+        c.close()
+    want = min_hash_range(data, sub[0], sub[1])
+    if got != want:
+        errors.append(
+            f"cross-replica probe ({data},{sub[0]},{sub[1]}) on "
+            f"{probe_rep.cell}: got {got}, want {want}"
+        )
+    ok = METRICS.get("sched.chunks_assigned") == assigned
+    if not ok:
+        errors.append("cross-replica probe assigned chunks (gossip missed)")
+    return ok and got == want
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--clients", type=int, default=8)
@@ -323,6 +564,12 @@ def main(argv=None) -> int:
     ap.add_argument("--overlap", action="store_true",
                     help="interval-store bench: nested/overlapping ranges, "
                          "SpanStore leg vs exact-match-cache leg")
+    ap.add_argument("--federation", type=int, default=0, metavar="N",
+                    help="federation bench (ISSUE 8): overlap-heavy load "
+                         "sprayed across N in-process gateway replicas "
+                         "(consistent-hash routing + span gossip) vs the "
+                         "same load on 1 replica; stamps the repeat and "
+                         "cross-replica zero-chunk probes (BENCH_pr8.json)")
     ap.add_argument("--trace", metavar="FILE", default=None,
                     help="arm the structured event log during the gateway "
                          "leg and write it here (python -m tools.trace)")
@@ -362,7 +609,16 @@ def main(argv=None) -> int:
 
     from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
 
-    jobs = build_overlap_workload(args) if args.overlap else build_workload(args)
+    if args.federation:
+        # Overlap-heavy workload over a wider key family, so the ring has
+        # keys to spread and the duplicates still collapse per home cell.
+        jobs = build_overlap_workload(
+            args, n_datas=max(3, 2 * args.federation)
+        )
+    elif args.overlap:
+        jobs = build_overlap_workload(args)
+    else:
+        jobs = build_workload(args)
     distinct = sorted(set(jobs))
     log(f"workload: {len(jobs)} jobs, {len(distinct)} distinct signatures, "
         f"{args.clients} clients, {args.miners} miners")
@@ -372,6 +628,8 @@ def main(argv=None) -> int:
     # transport/module init) so neither timed leg absorbs them.
     run_leg(False, jobs[: min(4, len(jobs))], args, oracle)
 
+    if args.federation:
+        return _federation_main(jobs, distinct, args, oracle)
     if args.overlap:
         return _overlap_main(jobs, distinct, args, oracle)
 
@@ -521,6 +779,57 @@ def main(argv=None) -> int:
             if base is not None
             else {}
         ),
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _federation_main(jobs, distinct, args, oracle) -> int:
+    """The --federation bench: the same duplicate/overlap-heavy workload
+    through N replicas vs 1 replica (both federation shells, so the delta
+    isolates the replication), plus the ISSUE 8 probes.  One JSON line —
+    the BENCH_pr8.json artifact."""
+    n = max(2, args.federation)
+    fed = run_federation_leg(n, jobs, args, oracle)
+    log(f"federation leg ({n} replicas): {fed['jobs_per_sec']:.2f} jobs/s "
+        f"over {fed['wall_s']:.2f}s; counters {fed['counters']}")
+    single = run_federation_leg(1, jobs, args, oracle)
+    log(f"single-replica leg: {single['jobs_per_sec']:.2f} jobs/s over "
+        f"{single['wall_s']:.2f}s")
+
+    out = {
+        "metric": "loadgen_federation_jobs_per_sec",
+        "value": round(fed["jobs_per_sec"], 3),
+        "unit": "jobs/s",
+        "mode": "federation",
+        "replicas": n,
+        "clients": args.clients,
+        "jobs": len(jobs),
+        "distinct_signatures": len(distinct),
+        "max_nonce": args.max_nonce,
+        "miners_per_replica": args.miners,
+        "seed": args.seed,
+        "fast": bool(args.fast),
+        "wall_s": round(fed["wall_s"], 3),
+        "latency_s": fed["latency_s"],
+        "repeat_zero_chunks": fed["repeat_zero_chunks"],
+        "cross_replica_zero_chunks": fed["cross_replica_zero_chunks"],
+        "gossip_max_frame_bytes": fed["gossip_max_frame_bytes"],
+        "wire_ceiling_bytes": 1000,
+        "federation_counters": {
+            k: v for k, v in fed["counters"].items()
+            if k.startswith(("federation.", "gateway."))
+        },
+        "swept_nonces": fed["counters"].get("sched.nonces_swept", 0),
+        "single_jobs_per_sec": round(single["jobs_per_sec"], 3),
+        "single_wall_s": round(single["wall_s"], 3),
+        "single_swept_nonces": single["counters"].get("sched.nonces_swept", 0),
+        "single_repeat_zero_chunks": single["repeat_zero_chunks"],
+        "scaling_vs_single": round(
+            fed["jobs_per_sec"] / single["jobs_per_sec"], 3
+        )
+        if single["jobs_per_sec"] > 0
+        else None,
     }
     print(json.dumps(out), flush=True)
     return 0
