@@ -18,15 +18,37 @@ from ..utils.metrics import METRICS
 
 
 def request_once(
-    client: "lsp.Client", message: str, max_nonce: int, lower: int = 0
+    client: "lsp.Client",
+    message: str,
+    max_nonce: int,
+    lower: int = 0,
+    timeout: Optional[float] = None,
 ) -> Optional[Tuple[int, int]]:
     """Send the job and block for its Result; None if the conn is lost.
     The CLI's frozen shape is ``[lower=0, max_nonce]``; in-process callers
-    (tools/loadgen.py's overlap workload) may sweep an interior range."""
-    client.write(Message.request(message, lower, max_nonce).marshal())
+    (tools/loadgen.py's overlap workload) may sweep an interior range.
+
+    ``timeout`` (seconds, whole-request deadline) raises the builtin
+    ``TimeoutError`` instead of blocking forever — the federation
+    forwarder's per-forward deadline, so one wedged peer conn cannot
+    head-of-line-block a forwarder worker.  After a timeout the conn's
+    read stream is undefined; the caller should close it."""
+    import time as _time
+
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    try:
+        client.write(Message.request(message, lower, max_nonce).marshal())
+    except lsp.LspError:
+        # A cached conn whose peer died raises at write time; that is
+        # "conn lost" under this function's contract, not an exception —
+        # the federation forwarder relies on this to survive the worker.
+        return None
     while True:
+        remaining = None
+        if deadline is not None:
+            remaining = max(0.0, deadline - _time.monotonic())
         try:
-            payload = client.read()
+            payload = client.read(timeout=remaining)
         except lsp.LspError:
             return None
         msg = Message.unmarshal(payload)

@@ -77,6 +77,7 @@ def run_drill(
     retries: int = 6,
     timeout: float = 120.0,
     trace_path: Optional[str] = None,
+    workload=None,
 ) -> DrillReport:
     """Run one seeded fleet-under-chaos drill; see module docstring.
 
@@ -84,14 +85,19 @@ def run_drill(
     drill's duration and flushes it there as JSONL on exit — a seeded
     chaos replay plus its trace is a deterministic diagnosis
     (``python -m tools.trace FILE`` rebuilds the request timelines and
-    the tier-abandonment WHYs, ISSUE 6)."""
+    the tier-abandonment WHYs, ISSUE 6).
+
+    ``workload`` runs the whole drilled fleet — scheduler validation,
+    miners, oracle — on a registered range-fold workload (ISSUE 9); the
+    chaos machinery itself is workload-blind, which is exactly what the
+    parameterized soak asserts."""
     from contextlib import nullcontext
 
     with trace.tracing(trace_path) if trace_path is not None else nullcontext():
         return _drill(
             scenario, seed, data, max_nonce, n_miners, kill_miner_at,
             epoch_millis, epoch_limit, window, min_chunk,
-            straggler_min_seconds, retries, timeout,
+            straggler_min_seconds, retries, timeout, workload,
         )
 
 
@@ -109,6 +115,7 @@ def _drill(
     straggler_min_seconds: float,
     retries: int,
     timeout: float,
+    workload=None,
 ) -> DrillReport:
     params = lsp.Params(epoch_limit, epoch_millis, window)
     name = scenario if isinstance(scenario, str) else (
@@ -132,7 +139,8 @@ def _drill(
 
     server = lsp.Server(0, params, label="server")
     sched = Scheduler(
-        min_chunk=min_chunk, straggler_min_seconds=straggler_min_seconds
+        min_chunk=min_chunk, straggler_min_seconds=straggler_min_seconds,
+        workload=workload,
     )
     threading.Thread(
         target=server_mod.serve,
@@ -146,13 +154,14 @@ def _drill(
         victim = lsp.Client("127.0.0.1", server.port, params, label="miner-0")
         threading.Thread(
             target=miner_mod.run_miner,
-            args=(victim, miner_mod.make_search("cpu")),
+            args=(victim, miner_mod.make_search("cpu", workload=workload)),
             daemon=True,
         ).start()
         for i in range(1, n_miners):
             threading.Thread(
                 target=miner_mod.run_miner_resilient,
-                args=("127.0.0.1", server.port, miner_mod.make_search("cpu")),
+                args=("127.0.0.1", server.port,
+                      miner_mod.make_search("cpu", workload=workload)),
                 kwargs={
                     "params": params,
                     "max_retries": 12,
@@ -196,7 +205,11 @@ def _drill(
         lspnet.reset_faults()
         server.close()
 
-    expected = min_hash_range(data, 0, max_nonce)
+    expected = (
+        min_hash_range(data, 0, max_nonce)
+        if workload is None
+        else workload.min_range(data, 0, max_nonce)
+    )
     after = METRICS.snapshot()
     deltas = {
         k: after[k] - before.get(k, 0)

@@ -51,8 +51,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     cache_path = None
     spans_path = None
     trace_path = os.environ.get("BMT_TRACE") or None
+    workload_name = os.environ.get("BMT_WORKLOAD") or None
     rate: Optional[float] = None
     gossip_interval = 1.0
+    forward_timeout = 15.0
     pos = []
     for a in argv[1:]:
         if a.startswith("--cell="):
@@ -73,6 +75,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             rate = float(a.split("=", 1)[1]) or None
         elif a.startswith("--gossip-interval="):
             gossip_interval = float(a.split("=", 1)[1])
+        elif a.startswith("--forward-timeout="):
+            forward_timeout = float(a.split("=", 1)[1])
+        elif a.startswith("--workload="):
+            workload_name = a.split("=", 1)[1]
         else:
             pos.append(a)
     if len(pos) != 1:
@@ -98,18 +104,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ..utils.trace import TRACE
 
         TRACE.enable(path=trace_path)
+    from ..workloads import resolve as resolve_workload
+    from ..workloads import resolve_nondefault
+
+    try:
+        workload = resolve_workload(workload_name)
+    except ValueError as e:
+        print(str(e))
+        return 0
+    wl = resolve_nondefault(workload)
     try:
         replica = Replica(
             cell,
             peers,
             port=port,
             fed_port=fed_port,
-            cache=ResultCache(path=cache_path),
-            spans=GossipSpanStore(path=spans_path),
+            cache=ResultCache(path=cache_path, workload=workload.name),
+            spans=GossipSpanStore(path=spans_path, workload=workload.name),
             rate=rate,
             gossip_interval=gossip_interval,
+            forward_timeout=forward_timeout,
             checkpoint_path=checkpoint_path,
             tick_interval=1.0,
+            workload=wl,
         )
     except OSError as e:
         print(str(e))

@@ -32,6 +32,41 @@ from ..utils.metrics import METRICS
 SearchFn = Callable[[str, int, int], Tuple[int, int]]  # -> (hash, nonce)
 
 
+def _is_default(workload) -> bool:
+    """True when ``workload`` is the frozen mining default (or unset) —
+    those ride the original, byte-identical factory code below; every
+    other registered workload builds from its own tier factories.  The
+    contract itself lives in workloads.resolve_nondefault (lazy import:
+    the default path must not pull the registry in at module import)."""
+    if workload is None:
+        return True
+    from ..workloads import resolve_nondefault
+
+    return resolve_nondefault(workload) is None
+
+
+def _resolve_tier(backend: str, workload, devices: Optional[int] = None) -> str:
+    """Map the miner's ``--backend`` vocabulary onto a workload's tier
+    ladder: ``auto`` picks the strongest tier this host can actually run
+    (pallas only on TPU; a CPU mesh test rig gets the sharded xla tier),
+    a named tier must exist on the ladder."""
+    tiers = workload.tiers
+    if backend == "auto":
+        from ..utils.platform import is_tpu
+
+        if is_tpu() and "pallas" in tiers:
+            return "pallas"
+        if devices is not None and devices != 1 and "xla" in tiers:
+            return "xla"  # CPU mesh (tests): sharded xla pipeline
+        return "cpu" if "cpu" in tiers else tiers[-1]
+    if backend in tiers:
+        return backend
+    raise ValueError(
+        f"workload {workload.name!r} has no {backend!r} tier "
+        f"(ladder: {'->'.join(tiers)})"
+    )
+
+
 def _time_chunk(fut, lo: int, hi: int) -> None:
     """Attach miner-side chunk timing to a search future: submit→solve
     wall time into ``hist.miner_chunk_s`` plus a trace event when armed —
@@ -54,8 +89,18 @@ def _time_chunk(fut, lo: int, hi: int) -> None:
     fut.add_done_callback(_done)
 
 
-def make_search(backend: str = "auto", devices: Optional[int] = None) -> SearchFn:
-    """Build the (data, lower, upper) -> (min_hash, nonce) search function."""
+def make_search(
+    backend: str = "auto", devices: Optional[int] = None, workload=None
+) -> SearchFn:
+    """Build the (data, lower, upper) -> (min_hash, nonce) search function.
+
+    ``workload`` (ISSUE 9) selects a registered range-fold workload; the
+    search is then built from that workload's own tier factories.  None
+    (or the frozen default) keeps the pre-registry code path
+    byte-identical."""
+    if workload is not None and not _is_default(workload):
+        tier = _resolve_tier(backend, workload, devices)
+        return workload.make_search(tier, devices)
     if backend == "cpu":
         if devices is not None and devices != 1:
             raise ValueError(
@@ -132,7 +177,10 @@ class _PipelineSearch:
     chunk computes, so back-to-back Requests cost zero device idle."""
 
     def __init__(
-        self, backend: Optional[str], devices: Optional[int] = None
+        self,
+        backend: Optional[str],
+        devices: Optional[int] = None,
+        workload=None,
     ) -> None:
         from concurrent.futures import Future
 
@@ -144,7 +192,7 @@ class _PipelineSearch:
 
             mesh = default_mesh(devices)
         self._Future = Future
-        self._p = SweepPipeline(backend=backend, mesh=mesh)
+        self._p = SweepPipeline(backend=backend, mesh=mesh, workload=workload)
 
     def submit(self, data: str, lower: int, upper: int):
         out = self._Future()
@@ -170,12 +218,18 @@ class _PipelineSearch:
         self._p.close()
 
 
-def make_async_search(backend: str = "auto", devices: Optional[int] = None):
+def make_async_search(
+    backend: str = "auto", devices: Optional[int] = None, workload=None
+):
     """Build the async (submit -> Future of (hash, nonce)) search the miner
     serves Requests with.  JAX tiers get the cross-request SweepPipeline —
     single-device or mesh-sharded (a multi-chip miner must not idle its
     whole mesh between chunks); only the cpu tier runs behind a
-    single-worker pool (FIFO, compute-bound anyway)."""
+    single-worker pool (FIFO, compute-bound anyway).  ``workload``: see
+    :func:`make_search`."""
+    if workload is not None and not _is_default(workload):
+        tier = _resolve_tier(backend, workload, devices)
+        return workload.make_async_search(tier, devices)
     multi = devices is not None and devices != 1
     if devices is not None and devices < 1:
         raise ValueError(f"--devices must be >= 1, got {devices}")
@@ -539,9 +593,28 @@ def make_tiered_search(
     backend: str = "auto",
     devices: Optional[int] = None,
     wedge_seconds: float = 30.0,
+    workload=None,
 ) -> _TieredSearch:
     """The self-healing search: the requested tier first, every strictly
-    weaker tier behind it, hashlib last (pure Python cannot wedge)."""
+    weaker tier behind it, hashlib last (pure Python cannot wedge).
+
+    The chain is the workload's OWN tier ladder (ISSUE 9): a workload
+    with no device kernels still downgrades sanely (e.g. blake2b64's
+    cpu → hashlib), and a SHA-256-template workload rides the full
+    pallas → xla → cpu → hashlib ladder like the frozen default."""
+    if workload is not None and not _is_default(workload):
+        tiers = list(workload.tiers)
+        backend = _resolve_tier(backend, workload, devices)
+        chain = [
+            (
+                t,
+                lambda t=t: workload.make_async_search(
+                    t, devices if t in ("pallas", "xla") else None
+                ),
+            )
+            for t in tiers[tiers.index(backend):]
+        ]
+        return _TieredSearch(chain, wedge_seconds=wedge_seconds)
     from ..bitcoin.hash import min_hash_range as _oracle
 
     if backend == "auto":
@@ -660,6 +733,13 @@ def main(argv=None) -> int:
     # wedge timeout.
     parser.add_argument("--reconnect", type=int, default=5)
     parser.add_argument("--watchdog", type=float, default=None)
+    # Registered range-fold workload (ISSUE 9): the hash family this
+    # miner sweeps.  Must match the server's --workload (the wire never
+    # names workloads); BMT_WORKLOAD is the env spelling for subprocess
+    # benches.  Default: the frozen mining contract.
+    parser.add_argument(
+        "--workload", default=os.environ.get("BMT_WORKLOAD") or None
+    )
     # Telemetry sidecar (ISSUE 7): ship periodic metric snapshots to the
     # server's --telemetry-port over a SECOND LSP connection.  Entirely
     # off the sweep path (a daemon timer thread with its own conn and
@@ -690,17 +770,35 @@ def main(argv=None) -> int:
         if None in (args.coordinator, args.num_hosts, args.host_id):
             print("--multihost requires --coordinator, --num-hosts, --host-id")
             return 0
+        from ..workloads import resolve_nondefault
+
+        try:
+            nondefault = resolve_nondefault(args.workload)
+        except ValueError as e:
+            print("Invalid miner configuration:", e)
+            return 0
+        if nondefault is not None:
+            # Lockstep pod sweep: frozen default only (for now).
+            print("Invalid miner configuration:",
+                  "--multihost supports the default workload only")
+            return 0
         run_miner_multihost(
             args.hostport, args.coordinator, args.num_hosts, args.host_id
         )
         return 0
     try:
+        from ..workloads import resolve as resolve_workload
+
+        workload = resolve_workload(args.workload)
         if args.watchdog is not None:
             search = make_tiered_search(
-                args.backend, args.devices, wedge_seconds=args.watchdog
+                args.backend, args.devices, wedge_seconds=args.watchdog,
+                workload=workload,
             )
         else:
-            search = make_async_search(args.backend, args.devices)
+            search = make_async_search(
+                args.backend, args.devices, workload=workload
+            )
     except ValueError as e:
         print("Invalid miner configuration:", e)
         return 0

@@ -69,6 +69,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from ..bitcoin.hash import hash_nonce
 from ..bitcoin.message import Message
+from ..workloads import DEFAULT_WORKLOAD, Workload, stamp_state, unwrap_state
 from ..utils import trace as _trace  # _trace: the event-log module; job.trace / the
 # ``trace=`` event parameter are per-request ids (ISSUE 6)
 from ..utils.intervals import intersect_intervals, merge_intervals
@@ -179,9 +180,20 @@ class Scheduler:
         record_spans: bool = False,
         span_export_max: int = 4096,
         resume_state: Optional[dict] = None,
+        workload: Optional[Workload] = None,
     ) -> None:
         if pipeline_depth < 1:
             raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
+        # The range-fold workload this scheduler serves (ISSUE 9): its
+        # oracle validates every Result before folding.  None = the
+        # frozen mining default, byte-identical to the pre-registry
+        # behavior (hash_nonce stays the module-level import so the
+        # default never touches the registry).
+        self.workload = workload
+        self.workload_name = (
+            DEFAULT_WORKLOAD if workload is None else workload.name
+        )
+        self._oracle = hash_nonce if workload is None else workload.hash_nonce
         self.min_chunk = min_chunk
         self.max_chunk = max_chunk
         self.target_chunk_seconds = target_chunk_seconds
@@ -319,7 +331,7 @@ class Scheduler:
         job = self.jobs.get(front.job)  # None if the client died meanwhile
 
         if job is not None and self.validate_results:
-            valid = lo <= nonce <= hi and hash_nonce(job.data, nonce) == hash_
+            valid = lo <= nonce <= hi and self._oracle(job.data, nonce) == hash_
             if not valid:
                 return self._reject_result(miner, job, now)
 
@@ -511,15 +523,27 @@ class Scheduler:
             }
             for key, (best, remaining) in merged.items()
         ]
-        return {"version": 1, "jobs": jobs}
+        return stamp_state({"jobs": jobs}, self.workload_name)
 
     def load_checkpoint(self, state: dict) -> None:
         """Stage checkpointed progress; consumed when a client resubmits the
         identical ``(data, lower, upper)`` Request.  Duplicate keys — in the
         state, or already staged — merge conservatively: best-so-far
         min-folds and remaining work unions, so no snapshot ordering can
-        lose progress or skip unswept nonces."""
-        for j in state.get("jobs", ()):
+        lose progress or skip unswept nonces.
+
+        Checkpoints are stamped with their workload name (ISSUE 9): a
+        snapshot's best-so-far and remaining intervals are facts about
+        ONE hash function, so state written under a different workload
+        is ignored wholesale — resuming it would fold another function's
+        minima into this one's answers.  Pre-registry checkpoints (no
+        stamp) are the frozen default's; non-default checkpoints nest
+        their payload (workloads.stamp_state) so pre-registry readers
+        sharing the path also load them as empty."""
+        payload = unwrap_state(state, self.workload_name)
+        if payload is None:
+            return
+        for j in payload.get("jobs", ()):
             key = (j["data"], j["lower"], j["upper"])
             best = tuple(j["best"]) if j.get("best") else None
             remaining = [tuple(iv) for iv in j["remaining"]]

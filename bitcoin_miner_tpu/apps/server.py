@@ -391,6 +391,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     fleet_log = os.environ.get("BMT_FLEET_LOG") or None
     prom_path = os.environ.get("BMT_PROM") or None
     slo_conf = os.environ.get("BMT_SLO") or None
+    # Registered range-fold workload (ISSUE 9): the hash family this
+    # server schedules and validates.  The wire protocol never names
+    # workloads, so server, miners and federation peers must agree on
+    # the flag; BMT_WORKLOAD is the subprocess-bench env spelling.
+    workload_name = os.environ.get("BMT_WORKLOAD") or None
     rate: Optional[float] = 5.0
     burst = 10.0
     max_queued = 256
@@ -410,6 +415,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             slo_conf = "1"
         elif a.startswith("--slo="):
             slo_conf = a.split("=", 1)[1]
+        elif a.startswith("--workload="):
+            workload_name = a.split("=", 1)[1]
         elif a == "--gateway":
             gateway_on = True
         elif a.startswith("--cache="):
@@ -466,15 +473,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ..utils.trace import TRACE
 
         TRACE.enable(path=trace_path)
+    from ..workloads import resolve as resolve_workload
+    from ..workloads import resolve_nondefault
+
+    try:
+        workload = resolve_workload(workload_name)
+    except ValueError as e:
+        print(str(e))
+        server.close()
+        return 0
     resume = load_checkpoint(checkpoint_path) if checkpoint_path else None
-    sched = Scheduler(resume_state=resume)
+    # Scheduler(workload=None) is the frozen default's byte-identical
+    # path; only a non-default selection threads the registry object in
+    # (the contract lives in resolve_nondefault, not here).
+    wl = resolve_nondefault(workload)
+    sched = Scheduler(resume_state=resume, workload=wl)
     if gateway_on:
         from ..gateway import Gateway, ResultCache, SpanStore
 
         sched = Gateway(
             sched,
-            cache=ResultCache(path=cache_path),
-            spans=SpanStore(path=spans_path),
+            cache=ResultCache(path=cache_path, workload=workload.name),
+            spans=SpanStore(path=spans_path, workload=workload.name),
             rate=rate,
             burst=burst,
             max_queued=max_queued,

@@ -112,10 +112,11 @@ class GossipSpanStore(SpanStore):
         max_spans_per_data: int = 64,
         path: Optional[str] = None,
         journal_max: int = 4096,
+        workload: Optional[str] = None,
     ) -> None:
         self.journal_max = max(1, int(journal_max))
         self._journal: Deque[WireSpan] = deque(maxlen=self.journal_max)
-        super().__init__(capacity, max_spans_per_data, path)
+        super().__init__(capacity, max_spans_per_data, path, workload=workload)
 
     def add(self, data: str, lo: int, hi: int, hash_: int, nonce: int) -> None:
         if self.capacity == 0 or lo > hi or not (lo <= nonce <= hi):

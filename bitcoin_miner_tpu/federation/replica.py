@@ -55,8 +55,21 @@ from .ring import Ring
 #: ints — 2**40 is unreachable by either.
 FED_BASE = 1 << 40
 
-#: One forward task: (public conn, data, lower, upper, request time).
-_Forward = Tuple[int, str, int, int, float]
+#: One forward task: (public conn, data, lower, upper, originating
+#: admission identity — propagated to the home cell so one noisy tenant
+#: behind a peer cannot starve that peer's other tenants — request time).
+_Forward = Tuple[int, str, int, int, Optional[str], float]
+
+#: Identity preamble on the federation conn: ``FK1|<client key>`` sent
+#: immediately before each forwarded Request.  LSP delivers in order, so
+#: the home cell reads the origin key, then the Request it applies to.
+#: Not a frozen-protocol change: the federation port is the replicated
+#: tier's internal channel (like ``T1|`` gossip frames), the public
+#: client/miner wire is untouched.
+_FK_PREFIX = b"FK1|"
+#: Origin keys are labels, not payload storage — bound them well under
+#: the frozen 1000-byte datagram ceiling.
+_FK_MAX_KEY = 200
 
 
 class _Router:
@@ -111,7 +124,9 @@ class _Router:
                 # drowning, serving locally through normal admission
                 # (queue/shed) beats buffering requests without limit.
                 try:
-                    r._fwd_q.put_nowait((conn_id, data, lower, upper, now))
+                    r._fwd_q.put_nowait(
+                        (conn_id, data, lower, upper, client_key, now)
+                    )
                 except queue.Full:
                     METRICS.inc("federation.local_fallbacks")
                 else:
@@ -225,7 +240,9 @@ class Replica:
         gossip_interval: float = 1.0,
         gossip_full_every: int = 4,
         forward_workers: int = 4,
+        forward_timeout: float = 15.0,
         peer_down_ttl: float = 2.0,
+        workload=None,
         tick_interval: float = 0.25,
         checkpoint_path: Optional[str] = None,
         telemetry=None,
@@ -245,10 +262,15 @@ class Replica:
         # gossip clients gossip-<cell>, forward clients fwd-<cell>.
         self.public = lsp.Server(port, params, host=host, label=cell)
         self.fed = lsp.Server(fed_port, params, host=host, label=f"fed-{cell}")
-        self.spans = spans if spans is not None else GossipSpanStore()
+        # The cell's range-fold workload (ISSUE 9) stamps every state
+        # file below; every cell of one federation must agree.
+        wname = getattr(workload, "name", None)
+        self.spans = (
+            spans if spans is not None else GossipSpanStore(workload=wname)
+        )
         self.gateway = Gateway(
-            scheduler if scheduler is not None else Scheduler(),
-            cache=cache if cache is not None else ResultCache(),
+            scheduler if scheduler is not None else Scheduler(workload=workload),
+            cache=cache if cache is not None else ResultCache(workload=wname),
             spans=self.spans,
             rate=rate,
             max_queued=max_queued,
@@ -264,6 +286,12 @@ class Replica:
         self._checkpoint_path = checkpoint_path
         self._telemetry = telemetry
         self._forward_workers = max(1, int(forward_workers))
+        # Per-forward deadline (ISSUE 9 satellite): a wedged peer conn —
+        # transport alive, scheduler starved — used to block its worker
+        # in request_once forever, head-of-line-blocking ALL forwarding
+        # on this replica; now the forward times out, counts
+        # federation.forward_timeouts, and fails over / falls back local.
+        self._forward_timeout = forward_timeout
         self._peer_down_ttl = peer_down_ttl
         # Bounded relay backlog (overflow serves locally through normal
         # admission); conns with a forward in flight, so the router can
@@ -382,11 +410,17 @@ class Replica:
         (served locally under the shared event lock) and framed span
         gossip.  Frame reassembly is per-conn and this-thread-only."""
         assemblers: Dict[int, FrameAssembler] = {}
+        # Originating admission identities, per conn (ISSUE 9 satellite):
+        # a forwarder sends ``FK1|<key>`` right before each Request, so
+        # the home cell charges the ORIGINATING client's bucket/tenant
+        # instead of pooling a whole peer under one "fed:peer" key.
+        fed_keys: Dict[int, str] = {}
         while True:
             try:
                 conn_id, payload = self.fed.read()
             except lsp.ConnLostError as e:
                 assemblers.pop(e.conn_id, None)
+                fed_keys.pop(e.conn_id, None)
                 with self.lock:
                     actions = self.router._split(
                         self.gateway.lost(FED_BASE + e.conn_id, self._clock())
@@ -395,6 +429,11 @@ class Replica:
                 continue
             except lsp.LspError:
                 return  # replica closed
+            if payload.startswith(_FK_PREFIX):
+                fed_keys[conn_id] = payload[len(_FK_PREFIX):].decode(
+                    "utf-8", "replace"
+                )[:_FK_MAX_KEY]
+                continue
             if payload.startswith(b"T1|"):
                 asm = assemblers.get(conn_id)
                 if asm is None:
@@ -416,11 +455,16 @@ class Replica:
             if m is None or m.type != MsgType.REQUEST:
                 continue  # peers only forward Requests here
             now = self._clock()
+            # End-to-end admission identity: the preamble's origin key if
+            # one preceded this Request (consumed — the next Request on
+            # this conn brings its own), else the legacy pooled key.
+            origin = fed_keys.pop(conn_id, None)
+            fwd_key = f"fed:{origin}" if origin else "fed:peer"
             with self.lock:
                 actions = self.router._split(
                     self.gateway.client_request(
                         FED_BASE + conn_id, m.data, m.lower, m.upper, now,
-                        client_key="fed:peer",
+                        client_key=fwd_key,
                     )
                 )
                 evicted = self.router.drain_evictions()
@@ -457,12 +501,22 @@ class Replica:
                 task = self._fwd_q.get()
                 if task is None:
                     return
-                conn_id, data, lower, upper, t0 = task
+                conn_id, data, lower, upper, ckey, t0 = task
                 result = None
                 order = [n for n in self.ring.route(data) if n != self.cell]
                 candidates = [n for n in order if not self._peer_is_down(n)]
                 for name in candidates:
-                    result = self._forward_once(clients, name, data, lower, upper)
+                    try:
+                        result = self._forward_once(
+                            clients, name, data, lower, upper, ckey
+                        )
+                    except TimeoutError:
+                        # Wedged-but-alive peer (forward_timeouts already
+                        # counted): skip it for the down-TTL so queued
+                        # tasks don't each burn a full deadline on it,
+                        # but do NOT count a dead-replica failover.
+                        self._mark_peer(name, down=True)
+                        continue
                     if result is not None:
                         self._mark_peer(name, down=False)
                         break
@@ -508,7 +562,9 @@ class Replica:
                     actions = self.router._split(
                         self.gateway.client_request(
                             conn_id, data, lower, upper, self._clock(),
-                            client_key="fed:fallback",
+                            # Fallback serves the ORIGINATING client:
+                            # charge its own admission identity.
+                            client_key=ckey or "fed:fallback",
                         )
                     )
                 self._emit_public(actions)
@@ -526,6 +582,7 @@ class Replica:
         data: str,
         lower: int,
         upper: int,
+        ckey: Optional[str] = None,
     ) -> Optional[Tuple[int, int]]:
         client = clients.get(name)
         if client is None:
@@ -537,13 +594,46 @@ class Replica:
             except (lsp.LspError, OSError):
                 return None
             clients[name] = client
-        got = request_once(client, data, upper, lower=lower)
-        if got is None:
-            # Conn died mid-request (peer killed, or shed us): drop the
-            # cached conn so the next task reconnects fresh.
+
+        def _drop_conn() -> None:
             clients.pop(name, None)
             try:
                 client.close()
             except lsp.LspError:
                 pass
+
+        if ckey:
+            # Identity preamble (see _FK_PREFIX): in-order LSP delivery
+            # binds it to the Request that follows.
+            try:
+                client.write(
+                    _FK_PREFIX + ckey.encode("utf-8")[:_FK_MAX_KEY]
+                )
+            except lsp.LspError:
+                _drop_conn()
+                return None
+        try:
+            got = request_once(
+                client, data, upper, lower=lower,
+                timeout=self._forward_timeout,
+            )
+        except TimeoutError:
+            # The peer's transport is alive but its answer never came
+            # (wedged cell, starved scheduler): without this deadline the
+            # worker blocked here forever and a few such forwards
+            # head-of-line-blocked ALL forwarding on this replica.  The
+            # conn's read stream is now ambiguous — drop it; the caller
+            # fails over along the ring (or serves locally).
+            METRICS.inc("federation.forward_timeouts")
+            _trace.emit(
+                None, "fed", "forward_timeout",
+                cell=self.cell, peer=name, data=data[:64],
+                budget_s=self._forward_timeout,
+            )
+            _drop_conn()
+            raise
+        if got is None:
+            # Conn died mid-request (peer killed, or shed us): drop the
+            # cached conn so the next task reconnects fresh.
+            _drop_conn()
         return got

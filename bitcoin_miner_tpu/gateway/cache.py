@@ -41,6 +41,7 @@ from typing import List, Optional, Tuple
 from ..utils.intervals import Interval, IntervalMap
 from ..utils.metrics import METRICS
 from ..utils.persist import load_json, save_json_atomic
+from ..workloads import DEFAULT_WORKLOAD, stamp_state, unwrap_state
 
 JobKey = Tuple[str, int, int]  # (data, lower, upper) — the job signature
 
@@ -51,9 +52,20 @@ class ResultCache:
     Not thread-safe by itself — the gateway serializes access under the
     server shell's event lock, like every other policy structure."""
 
-    def __init__(self, capacity: int = 1024, path: Optional[str] = None) -> None:
+    def __init__(
+        self,
+        capacity: int = 1024,
+        path: Optional[str] = None,
+        workload: Optional[str] = None,
+    ) -> None:
         self.capacity = max(0, int(capacity))
         self.path = path
+        # Cached (hash, nonce) pairs are facts about ONE hash function:
+        # the file is stamped with its workload name and a store serving
+        # a different workload starts empty instead of answering with
+        # another function's minima (ISSUE 9).  None = frozen default,
+        # which also owns pre-registry (unstamped) files.
+        self.workload_name = workload or DEFAULT_WORKLOAD
         self._entries: "OrderedDict[JobKey, Tuple[int, int]]" = OrderedDict()
         self._dirty = False
         if path is not None:
@@ -81,14 +93,16 @@ class ResultCache:
     # ------------------------------------------------------------ persistence
 
     def _serialize(self) -> dict:
-        return {
-            "version": 1,
-            # LRU order (oldest first) so a reload evicts the same way.
-            "entries": [
-                [k[0], k[1], k[2], h, n]
-                for k, (h, n) in self._entries.items()
-            ],
-        }
+        return stamp_state(
+            {
+                # LRU order (oldest first) so a reload evicts the same way.
+                "entries": [
+                    [k[0], k[1], k[2], h, n]
+                    for k, (h, n) in self._entries.items()
+                ],
+            },
+            self.workload_name,
+        )
 
     def flush(self) -> Optional[dict]:
         """The serializable state if dirty (clears the flag), else None.
@@ -110,9 +124,11 @@ class ResultCache:
         save_json_atomic(path, self._serialize())
 
     def _load(self, path: str) -> None:
-        state = load_json(path)
+        # Missing/torn file OR another workload's minima: start empty
+        # (non-default payloads are nested — see workloads.stamp_state).
+        state = unwrap_state(load_json(path), self.workload_name)
         if state is None:
-            return  # missing/torn file: start empty (same as checkpoint)
+            return
         for entry in state.get("entries", ()):
             try:
                 data, lower, upper, h, n = entry
@@ -146,10 +162,13 @@ class SpanStore:
         capacity: int = 512,
         max_spans_per_data: int = 64,
         path: Optional[str] = None,
+        workload: Optional[str] = None,
     ) -> None:
         self.capacity = max(0, int(capacity))
         self.max_spans_per_data = max(1, int(max_spans_per_data))
         self.path = path
+        # Same per-workload stamp contract as ResultCache (ISSUE 9).
+        self.workload_name = workload or DEFAULT_WORKLOAD
         self._maps: "OrderedDict[str, IntervalMap]" = OrderedDict()
         self._dirty = False
         if path is not None:
@@ -194,14 +213,16 @@ class SpanStore:
     # ------------------------------------------------------------ persistence
 
     def _serialize(self) -> dict:
-        return {
-            "version": 1,
-            # LRU order (oldest first) so a reload evicts the same way.
-            "data": [
-                [data, [list(s) for s in m.spans()]]
-                for data, m in self._maps.items()
-            ],
-        }
+        return stamp_state(
+            {
+                # LRU order (oldest first) so a reload evicts the same way.
+                "data": [
+                    [data, [list(s) for s in m.spans()]]
+                    for data, m in self._maps.items()
+                ],
+            },
+            self.workload_name,
+        )
 
     def flush(self) -> Optional[dict]:
         """Same contract as :meth:`ResultCache.flush`: the serializable
@@ -220,9 +241,11 @@ class SpanStore:
         save_json_atomic(path, self._serialize())
 
     def _load(self, path: str) -> None:
-        state = load_json(path)
+        # Missing/torn file OR another workload's minima: start empty
+        # (non-default payloads are nested — see workloads.stamp_state).
+        state = unwrap_state(load_json(path), self.workload_name)
         if state is None:
-            return  # missing/torn file: start empty (same as checkpoint)
+            return
         for entry in state.get("data", ()):
             try:
                 data, rows = entry

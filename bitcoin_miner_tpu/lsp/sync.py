@@ -14,6 +14,7 @@ import asyncio
 import threading
 from concurrent.futures import CancelledError as FutureCancelledError
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Callable, Coroutine, Optional, Tuple
 
 from ..utils import trace
@@ -64,6 +65,15 @@ class _LoopThread:
             # concurrent.futures class when stop() cancels it before the
             # coroutine ran, which is NOT asyncio.CancelledError here.
             raise ConnClosedError()
+        except FutureTimeoutError:
+            # Deadline expired with the coroutine still pending: cancel it
+            # on the loop and surface the builtin TimeoutError.  The conn
+            # itself stays open, but a cancelled read may race an arriving
+            # message — callers that time out should treat the conn's read
+            # stream as undefined and close it (the federation forwarder
+            # does exactly that).
+            fut.cancel()
+            raise TimeoutError(f"no result within {timeout:g}s")
 
     def call(self, fn: Callable, *args: Any) -> Any:
         """Run a plain callable on the loop thread (for non-async mutations
@@ -121,9 +131,13 @@ class Client:
     def conn_id(self) -> int:
         return self._c.conn_id
 
-    def read(self) -> bytes:
-        """Block until the next in-order message; raises after loss/close."""
-        return self._lt.run(self._c.read())
+    def read(self, timeout: Optional[float] = None) -> bytes:
+        """Block until the next in-order message; raises after loss/close.
+        ``timeout`` (seconds) raises the builtin ``TimeoutError`` instead
+        of blocking forever — after a timeout the conn's read stream is
+        undefined (a message may have raced the cancellation), so close
+        it rather than reading again."""
+        return self._lt.run(self._c.read(), timeout)
 
     def write(self, payload: bytes) -> None:
         self._lt.call(self._c.write, payload)

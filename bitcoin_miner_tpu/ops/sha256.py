@@ -272,17 +272,24 @@ class MsgLayout:
         return (self.n_tail_blocks, self.digit_pos)
 
 
-def build_layout(data: bytes, digit_count: int) -> MsgLayout:
-    """Build the layout for messages ``data + b' ' + <digit_count digits>``.
+def build_layout(data: bytes, digit_count: int, sep: bytes = b" ") -> MsgLayout:
+    """Build the layout for messages ``data + sep + <digit_count digits>``.
 
     Standard SHA-256 padding: message || 0x80 || zeros || 64-bit big-endian
     bit length, to a multiple of 64 bytes.  Blocks wholly inside the constant
-    prefix (data + space) are folded into the midstate host-side — for long
-    job data the device then hashes only the final block(s).
+    prefix (data + separator) are folded into the midstate host-side — for
+    long job data the device then hashes only the final block(s).
+
+    ``sep`` is the workload family's degree of freedom (ISSUE 9): the
+    frozen mining default hashes ``"<data> <nonce>"``; any registered
+    SHA-256-template workload supplies its own separator bytes and every
+    kernel tier downstream works unchanged — digit positions (and hence
+    compiled kernel shapes) depend only on the prefix *length*, while
+    the separator's content rides the midstate/template operands.
     """
     if digit_count < 1 or digit_count > 20:  # uint64 max has 20 digits
         raise ValueError(f"digit_count out of range: {digit_count}")
-    prefix = data + b" "
+    prefix = data + sep
     c_len = len(prefix)
     msg_len = c_len + digit_count
     n_blocks = (msg_len + 9 + 63) // 64

@@ -184,8 +184,30 @@ def _make_kernel(
 
 
 @lru_cache(maxsize=256)
-def _layout_cache(data: bytes, d: int) -> MsgLayout:
-    return build_layout(data, d)
+def _layout_cache(data: bytes, d: int, sep: bytes = b" ") -> MsgLayout:
+    return build_layout(data, d, sep=sep)
+
+
+def _workload_knobs(workload) -> Tuple[bytes, object, bool]:
+    """Resolve the (separator, host-min fn, native-allowed) triple a
+    sweep driver needs from a workload object (duck-typed: ``.sep``,
+    ``._cpu_search``, ``.native_ok`` — see workloads/base.py).  ``None``
+    means the frozen mining default, byte-identical to the pre-registry
+    behavior.  A workload without a SHA-256 message template cannot run
+    these drivers at all — that is a configuration error, not a silent
+    wrong answer."""
+    if workload is None:
+        return b" ", _host_min, True
+    if getattr(workload, "sep", None) is None:
+        raise ValueError(
+            f"workload {getattr(workload, 'name', workload)!r} has no "
+            "SHA-256 message template; its tier ladder has no device tier"
+        )
+    if getattr(workload, "native_ok", False):
+        return workload.sep, _host_min, True  # native == this workload's oracle
+    # The workload's cpu-tier loop (prefix-folded, one encode per call),
+    # not its per-nonce min_range oracle: host lanes sit on the hot path.
+    return workload.sep, workload._cpu_search(), False
 
 
 def _fill_templates(
@@ -277,16 +299,19 @@ def _host_min(data: str, lo: int, hi: int) -> Tuple[int, int]:
     return min_hash_range(data, lo, hi)
 
 
-def auto_host_lane_budget() -> int:
+def auto_host_lane_budget(native_ok: bool = True) -> int:
     """Largest digit-class size worth computing on the host instead of
-    compiling a device kernel for: ~0.1 s of host work either way."""
-    try:
-        from .. import native
+    compiling a device kernel for: ~0.1 s of host work either way.
+    ``native_ok=False`` (non-default workloads, whose host tier is the
+    hashlib-speed oracle) keeps the budget at the pure-Python level."""
+    if native_ok:
+        try:
+            from .. import native
 
-        if native.available():
-            return 10**7
-    except Exception:
-        pass
+            if native.available():
+                return 10**7
+        except Exception:
+            pass
     return 10**5
 
 
@@ -301,9 +326,16 @@ def run_sweep_dispatches(
     consume,
     max_inflight: int = 32,
     host_lane_budget: int = 0,
+    sep: bytes = b" ",
+    host_min=None,
 ) -> int:
     """The decompose → template-fill → dispatch skeleton shared by the
     single-device (below) and sharded (parallel/sweep.py) drivers.
+
+    ``sep``/``host_min`` are the workload knobs (``_workload_knobs``):
+    the message-template separator baked into each digit class's layout,
+    and the host-tier fold used for host-routed tiny classes (defaults =
+    the frozen mining workload).
 
     ``get_kernel(layout, group)`` builds/caches the kernel for a shape class;
     ``run_kernel(kern, midstate, tail_const, bounds)`` queues one dispatch
@@ -321,17 +353,19 @@ def run_sweep_dispatches(
     xla tier).  Returns the number of lanes swept.
     """
     data_bytes = data.encode("utf-8")
+    if host_min is None:
+        host_min = _host_min
     pending: Deque[Tuple] = collections.deque()
     lanes = 0
     for group in decompose_range(lower, upper, max_k=max_k):
         if 10**group.d <= host_lane_budget:
             g_lo = group.chunks[0].base + group.chunks[0].lo_off
             g_hi = group.chunks[-1].base + group.chunks[-1].hi_off - 1
-            h, n = _host_min(data, g_lo, g_hi)
+            h, n = host_min(data, g_lo, g_hi)
             pending.append((HostFold(h, n), None, None))
             lanes += sum(c.hi_off - c.lo_off for c in group.chunks)
             continue
-        layout = _layout_cache(data_bytes, group.d)
+        layout = _layout_cache(data_bytes, group.d, sep)
         kern = get_kernel(layout, group)
         midstate = np.array(layout.midstate, dtype=np.uint32)
         for s in range(0, len(group.chunks), batch):
@@ -469,12 +503,17 @@ class SweepPipeline:
         host_lane_budget: Optional[int] = None,
         mesh=None,
         axis_name: str = "miners",
+        workload=None,
     ) -> None:
         import queue as _queue
         import threading
         from concurrent.futures import Future
 
         self._Future = Future
+        # Workload knobs (ISSUE 9): the message-template separator and
+        # the host fold for host-routed tiny digit classes.  None = the
+        # frozen mining default, byte-identical to the pre-registry path.
+        self._sep, self._host_min, native_ok = _workload_knobs(workload)
         if mesh is not None and backend is None:
             # Resolve the backend from the MESH devices, not the process
             # default (same guard as sweep_min_hash_sharded: a CPU mesh in
@@ -498,7 +537,7 @@ class SweepPipeline:
         # None = auto: this is the miner's production path, where a tiny
         # digit class must never cost a Mosaic compile (see HostFold).
         self._host_lane_budget = (
-            auto_host_lane_budget() if host_lane_budget is None
+            auto_host_lane_budget(native_ok) if host_lane_budget is None
             else host_lane_budget
         )
         if mesh is not None:
@@ -581,7 +620,7 @@ class SweepPipeline:
         try:
             rep = 10 ** (d - 1)  # any nonce in the class: (d, k) is all
             group = next(decompose_range(rep, rep, max_k=self._max_k))
-            layout = _layout_cache(data.encode("utf-8"), group.d)
+            layout = _layout_cache(data.encode("utf-8"), group.d, self._sep)
             kern = self._get_kernel(layout, group)
             midstate = np.array(layout.midstate, dtype=np.uint32)
             tail_const, bounds = _fill_templates(
@@ -706,6 +745,8 @@ class SweepPipeline:
                     run_kernel,
                     consume,
                     host_lane_budget=self._host_lane_budget,
+                    sep=self._sep,
+                    host_min=self._host_min,
                 )
             except BaseException as e:  # resolve, don't kill the pipeline
                 self._fail(fut, e)
@@ -786,10 +827,13 @@ def sweep_min_hash(
     backend: Optional[str] = None,
     interpret: bool = False,
     host_lane_budget: int = 0,
+    workload=None,
 ) -> SweepResult:
     """Find ``(min Hash(data, n), argmin n)`` over inclusive ``[lower,
     upper]`` on the default JAX device.  Bit-exact vs the hashlib oracle
-    (``bitcoin_miner_tpu.bitcoin.hash_nonce``); ties -> lowest nonce.
+    (``bitcoin_miner_tpu.bitcoin.hash_nonce`` for the default;
+    ``workload.hash_nonce`` for any registered SHA-256-template
+    workload); ties -> lowest nonce.
 
     ``backend``: "pallas" (VMEM-resident kernel, the fast TPU path), "xla"
     (plain fused jnp — reference tier, also the CPU path), or None for
@@ -805,6 +849,7 @@ def sweep_min_hash(
     """
     backend, batch, max_k = auto_tune(backend, batch, max_k)
     rolled = not is_tpu()
+    sep, host_min, _native_ok = _workload_knobs(workload)
 
     def get_kernel(layout, group):
         return _build_kernel(
@@ -833,7 +878,7 @@ def sweep_min_hash(
 
     lanes = run_sweep_dispatches(
         data, lower, upper, max_k, batch, get_kernel, run_kernel, consume,
-        host_lane_budget=host_lane_budget,
+        host_lane_budget=host_lane_budget, sep=sep, host_min=host_min,
     )
     if not best:
         raise RuntimeError("sweep produced no candidates")

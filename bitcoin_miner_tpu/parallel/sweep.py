@@ -29,6 +29,7 @@ from ..ops.sweep import (
     I32_MAX,
     U32_MAX,
     SweepResult,
+    _workload_knobs,
     auto_tune,
     make_kernel_body,
     run_sweep_dispatches,
@@ -278,6 +279,7 @@ def sweep_min_hash_sharded(
     backend: Optional[str] = None,
     interpret: bool = False,
     stats: Optional[dict] = None,
+    workload=None,
 ) -> SweepResult:
     """Multi-chip ``(min Hash(data, n), argmin n)`` over inclusive
     ``[lower, upper]``; bit-exact vs the hashlib oracle, lowest-nonce ties.
@@ -348,8 +350,10 @@ def sweep_min_hash_sharded(
         if not best or cand < best[0]:
             best[:] = [cand]
 
+    sep, host_min, _native_ok = _workload_knobs(workload)
     lanes = run_sweep_dispatches(
-        data, lower, upper, max_k, batch, get_kernel, run_kernel, consume
+        data, lower, upper, max_k, batch, get_kernel, run_kernel, consume,
+        sep=sep, host_min=host_min,
     )
     if not best:
         raise RuntimeError("sharded sweep produced no candidates")

@@ -327,6 +327,7 @@ def format_quantiles(h) -> str:
 #:   federation.forwarded      requests routed to their home replica's federation port
 #:   federation.local_answers  non-home requests answered from local cache/gossiped spans
 #:   federation.forward_failovers  forward attempts re-routed past a dead replica
+#:   federation.forward_timeouts   forwards abandoned at the per-forward deadline
 #:   federation.local_fallbacks    forwards served locally (every peer unreachable)
 #:   federation.remote_results     forwarded requests answered by a peer's Result
 #:   federation.gossip_beats   span-gossip messages sent to a peer

@@ -1,0 +1,258 @@
+"""The workload registry: pluggable range-fold workloads by name (ISSUE 9).
+
+Every sweep consumer — ``apps/server``, ``apps/miner``,
+``apps/federation``, ``tools/loadgen.py``, ``tools/fleet_bench.py`` —
+resolves its workload here (``--workload=NAME``, env ``BMT_WORKLOAD``
+for subprocess benches) and threads the object through the stack:
+the scheduler validates Results with the workload's oracle, the miner
+builds its kernel-tier ladder from the workload's factories, and the
+analyzer's frozen-contract pass pins every registered workload's golden
+vectors so none can drift silently.
+
+Registered workloads:
+
+- ``sha256d`` — the FROZEN default: the reference mining contract
+  (single SHA-256 over ``"<data> <nonce>"``, first 8 digest bytes
+  big-endian — ``bitcoin/hash.go:13-17``; the name is the roadmap's
+  PAPERS.md-continuity label for the mining-default family).  Full tier
+  ladder incl. the native C++ SHA-NI sweep.  Byte-identical to the
+  pre-registry behavior everywhere; the wire protocol never names
+  workloads, so existing clients/miners/benches are untouched.
+- ``preimage`` — single-SHA-256 preimage/password search:
+  ``SHA-256("<data>:<nonce>")``, the lowest-hash-wins sweep a
+  closest-preimage search runs.  Same template family as the default,
+  so it inherits the ENTIRE device stack (pallas/xla kernels, midstate
+  folding) through the layout builder's separator parameter.
+- ``blake2b64`` — BLAKE2b-64 over ``"<data> <nonce>"`` (the
+  exchange-benchmark paper's fastest software family): no device tier,
+  host ladder only — the proof a registered workload without kernels
+  still rides the whole serving stack.
+
+One workload per process: the wire protocol stays the frozen
+``(data, lower, upper)`` triple, so a server, its miners, and its
+federation peers must agree on the workload out of band (the CLIs all
+take the same flag).  Per-workload state files (checkpoints, result
+caches, span stores) are stamped with the workload name and refuse to
+load across workloads — non-default files additionally nest their
+payload (:func:`stamp_state`) so pre-registry readers, which check no
+stamp, find nothing rather than another family's minima.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from .base import TIER_LADDER, GoldenVector, Workload  # noqa: F401
+from .blake2b import Blake2bWorkload
+from .sha256 import Sha256Workload
+
+#: The frozen-contract default every consumer uses when no workload is
+#: named — the pre-registry mining behavior, byte-identical.
+DEFAULT_WORKLOAD = "sha256d"
+
+#: Env spelling of ``--workload`` for subprocess benches
+#: (tools/fleet_bench.py spawns real server/miner/federation binaries).
+WORKLOAD_ENV = "BMT_WORKLOAD"
+
+_REGISTRY: Dict[str, Workload] = {}
+
+
+def register(workload: Workload) -> Workload:
+    """Add a workload to the registry (import-time; not thread-safe by
+    design — registration happens before any fleet exists).  Names are
+    final: re-registering one is a programming error, not an update."""
+    if not workload.name:
+        raise ValueError("workload has no name")
+    if workload.name in _REGISTRY:
+        raise ValueError(f"workload {workload.name!r} already registered")
+    if not workload.golden:
+        raise ValueError(
+            f"workload {workload.name!r} has no golden vectors — every "
+            "registered workload must pin its hash function in source "
+            "(the analyzer's contract pass recomputes them)"
+        )
+    if not workload.tiers or workload.tiers[-1] != "hashlib":
+        raise ValueError(
+            f"workload {workload.name!r} tier ladder must end at the "
+            "un-wedgeable 'hashlib' oracle tier"
+        )
+    unknown = [t for t in workload.tiers if t not in TIER_LADDER]
+    if unknown:
+        raise ValueError(
+            f"workload {workload.name!r} names unknown tiers {unknown}"
+        )
+    if workload.native_ok:
+        # native_ok is a claim the sweep drivers trust blindly (host
+        # lanes and the cpu tier route through the compiled default-format
+        # sweep) — so prove it here: the workload's oracle must BE the
+        # frozen default family, or hot-path host folds would silently
+        # hash a different message than the device lanes.
+        from ..bitcoin.hash import hash_nonce as _default_hash
+
+        for probe_data, probe_nonce in (("native-ok", 0), ("", 987654321)):
+            if workload.hash_nonce(probe_data, probe_nonce) != _default_hash(
+                probe_data, probe_nonce
+            ):
+                raise ValueError(
+                    f"workload {workload.name!r} sets native_ok but its "
+                    "hash_nonce disagrees with the frozen default family "
+                    "the native sweep computes"
+                )
+    _REGISTRY[workload.name] = workload
+    return workload
+
+
+def names() -> List[str]:
+    """Registered workload names, default first then sorted."""
+    rest = sorted(n for n in _REGISTRY if n != DEFAULT_WORKLOAD)
+    return [DEFAULT_WORKLOAD, *rest] if DEFAULT_WORKLOAD in _REGISTRY else rest
+
+
+def get(name: str) -> Workload:
+    """The workload registered under ``name``; raises ValueError with the
+    valid names (CLI-friendly) for anything unknown."""
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown workload {name!r}; registered: {', '.join(names())}"
+        ) from None
+
+
+def resolve(workload: Union[Workload, str, None]) -> Workload:
+    """Normalize a ``--workload`` value: None/"" -> the frozen default,
+    a name -> its registration, a Workload -> itself."""
+    if workload is None or workload == "":
+        return _REGISTRY[DEFAULT_WORKLOAD]
+    if isinstance(workload, Workload):
+        return workload
+    return get(workload)
+
+
+def resolve_nondefault(
+    workload: Union[Workload, str, None]
+) -> Optional[Workload]:
+    """:func:`resolve`, collapsed to the engine's internal vocabulary:
+    None for the frozen default, the registration for everything else.
+
+    The byte-identical-default contract — ``Scheduler(workload=None)``
+    and the original kernel factories never touch the registry — is
+    encoded HERE and nowhere else; entry points (server, miner,
+    federation, benches) must pass this function's result through
+    instead of re-deriving "is it the default?" locally."""
+    wl = resolve(workload)
+    return None if wl.name == DEFAULT_WORKLOAD else wl
+
+
+def stamp_state(payload: dict, workload_name: Optional[str]) -> dict:
+    """The persistence envelope for per-workload state files
+    (checkpoints, result caches, span stores).
+
+    The frozen default keeps the flat pre-registry version-1 shape (the
+    ``workload`` stamp is additive, so pre-registry readers still load
+    it).  Every other workload nests its payload under version 2:
+    pre-registry readers gate on neither version nor stamp — they read
+    the top-level payload keys directly — so those keys must NOT exist,
+    making an old (or rolled-back) binary sharing the path start empty
+    instead of silently folding another hash family's minima into its
+    answers."""
+    name = workload_name or DEFAULT_WORKLOAD
+    if name == DEFAULT_WORKLOAD:
+        return {"version": 1, "workload": name, **payload}
+    return {"version": 2, "workload": name, "state": payload}
+
+
+def unwrap_state(state: object, workload_name: Optional[str]) -> Optional[dict]:
+    """Inverse of :func:`stamp_state`: the payload iff ``state`` carries
+    ``workload_name``'s stamp, else None — foreign-workload, torn, or
+    unreadable files load empty.  Pre-registry files (no stamp, flat
+    shape) belong to the default."""
+    if not isinstance(state, dict):
+        return None
+    name = workload_name or DEFAULT_WORKLOAD
+    if state.get("workload", DEFAULT_WORKLOAD) != name:
+        return None
+    if state.get("version") == 2:
+        payload = state.get("state")
+        return payload if isinstance(payload, dict) else None
+    return state
+
+
+# --------------------------------------------------------------------------
+# Registrations.  Golden vectors are FROZEN literals — recomputed against
+# each workload's hash_nonce by the analyzer's contract pass on every run
+# (tools/analyze/contracts.py); edit them only with a contract bump.
+# --------------------------------------------------------------------------
+
+register(
+    Sha256Workload(
+        "sha256d",
+        sep=" ",
+        native_ok=True,
+        description=(
+            "frozen mining default: SHA-256('<data> <nonce>')[:8] "
+            "big-endian (reference bitcoin/hash.go parity)"
+        ),
+        golden=(
+            # Identical to the reference contract vectors the analyzer
+            # has always pinned (contracts.HASH_VECTORS).
+            ("hello", 0, 13593802692011500125),
+            ("hello", 12345, 6725106177369798965),
+            ("bitcoin", 999999999999, 12216901194327863447),
+            ("", 1, 16224919167884709661),
+            ("chaos", 4000, 9384656945151152569),
+        ),
+    )
+)
+
+register(
+    Sha256Workload(
+        "preimage",
+        sep=":",
+        description=(
+            "single-SHA-256 preimage/password search: "
+            "SHA-256('<data>:<nonce>')[:8] big-endian"
+        ),
+        golden=(
+            ("hello", 0, 5328521247272128883),
+            ("hello", 12345, 11940169400677209234),
+            ("bitcoin", 999999999999, 18080226961439275229),
+            ("", 1, 9812795669417250081),
+            ("chaos", 4000, 3383189675407663426),
+        ),
+    )
+)
+
+register(
+    Blake2bWorkload(
+        "blake2b64",
+        description=(
+            "BLAKE2b-64('<data> <nonce>') big-endian (exchange-benchmark "
+            "alternative hash family; host tiers only)"
+        ),
+        golden=(
+            ("hello", 0, 6710974778312606399),
+            ("hello", 12345, 16732439934857232814),
+            ("bitcoin", 999999999999, 8939386230447415819),
+            ("", 1, 18227269363522651860),
+            ("chaos", 4000, 4912459025450228006),
+        ),
+    )
+)
+
+__all__ = [
+    "DEFAULT_WORKLOAD",
+    "WORKLOAD_ENV",
+    "Workload",
+    "Sha256Workload",
+    "Blake2bWorkload",
+    "TIER_LADDER",
+    "GoldenVector",
+    "register",
+    "names",
+    "get",
+    "resolve",
+    "resolve_nondefault",
+    "stamp_state",
+    "unwrap_state",
+]

@@ -1,0 +1,68 @@
+"""BLAKE2b-64 workload: an alternative hash family behind the same stack.
+
+The exchange-benchmark paper (PAPERS.md, arxiv 2408.11950) evaluates
+hash families beyond SHA-256 for blockchain serving; BLAKE2b is its
+fastest software family and ships in hashlib, so it is the registry's
+proof that a workload with NO SHA-256 message template — and therefore
+no device tier — still rides the entire serving stack: scheduler
+validation, gateway cache/spans, federation routing, chaos drills.  Its
+tier ladder is ``cpu -> hashlib`` (the cpu tier is a prefix-folded batch
+loop, the hashlib tier the naive oracle); the watchdog chain degrades
+across exactly those rungs.
+
+``f(data, nonce) = BLAKE2b(digest_size=8)("<data> <nonce>")`` read
+big-endian — digest size is a parameter of the BLAKE2 spec (it keys the
+parameter block), so this is BLAKE2b-64 proper, not a truncation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional, Tuple
+
+from .base import GoldenVector, Workload
+
+
+class Blake2bWorkload(Workload):
+    """BLAKE2b-64 over ``"<data> <nonce>"`` (see module docstring)."""
+
+    tiers = ("cpu", "hashlib")
+    sep = None  # no SHA-256 message template: host tiers only
+    native_ok = False
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        description: str = "",
+        golden: Tuple[GoldenVector, ...] = (),
+    ) -> None:
+        self.name = name
+        self.description = description
+        self.golden = tuple(golden)
+
+    def hash_nonce(self, data: str, nonce: int) -> int:
+        digest = hashlib.blake2b(
+            f"{data} {nonce}".encode("utf-8"), digest_size=8
+        ).digest()
+        return int.from_bytes(digest, "big")
+
+    def _cpu_search(self):
+        """cpu tier: the prefix-folded batch loop (one encode per call,
+        digest-bytes compares) — a distinct, faster engine than the
+        :meth:`min_range` oracle that backs the ``hashlib`` rung."""
+        return self._cpu_range
+
+    def _cpu_range(self, data: str, lower: int, upper: int) -> Tuple[int, int]:
+        if lower > upper:
+            raise ValueError(f"empty nonce range [{lower}, {upper}]")
+        prefix = f"{data} ".encode("utf-8")
+        blake2b = hashlib.blake2b
+        best: Optional[bytes] = None  # 8-byte BE digest compares as the int
+        best_nonce = lower
+        for n in range(lower, upper + 1):
+            d = blake2b(prefix + str(n).encode("ascii"), digest_size=8).digest()
+            if best is None or d < best:
+                best, best_nonce = d, n
+        assert best is not None
+        return int.from_bytes(best, "big"), best_nonce

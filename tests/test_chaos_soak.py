@@ -41,6 +41,7 @@ from bitcoin_miner_tpu.lspnet.chaos import (
     partition,
     standard_scenarios,
 )
+from bitcoin_miner_tpu import workloads as workloads_mod
 from bitcoin_miner_tpu.utils.metrics import METRICS
 
 from lsp_harness import random_port
@@ -468,6 +469,24 @@ def test_fast_seeded_scenario_oracle_exact():
     report = run_drill(
         "burst-loss", seed=11, data="fastchaos", max_nonce=2500,
         n_miners=2, timeout=90.0,
+    )
+    assert report.ok, report.as_dict()
+    assert report.counters.get("chaos.dropped", 0) > 0, report.as_dict()
+
+
+@pytest.mark.workloads
+@pytest.mark.parametrize("wname", workloads_mod.names())
+def test_fast_seeded_scenario_oracle_exact_per_workload(wname):
+    """The same seeded burst-loss drill over EVERY registered range-fold
+    workload (ISSUE 9): the chaos/self-healing machinery is
+    workload-blind — scheduler validation, miner sweeps and the oracle
+    all come from the registry, and the Result stays bit-exact against
+    that workload's own hashlib oracle under packet loss."""
+    w = workloads_mod.get(wname)
+    report = run_drill(
+        "burst-loss", seed=11, data=f"wlchaos-{wname}", max_nonce=1500,
+        n_miners=2, timeout=90.0,
+        workload=None if wname == workloads_mod.DEFAULT_WORKLOAD else w,
     )
     assert report.ok, report.as_dict()
     assert report.counters.get("chaos.dropped", 0) > 0, report.as_dict()

@@ -566,3 +566,82 @@ def test_fed_port_never_forwards():
         assert METRICS.get("federation.forwarded") == forwarded
     finally:
         fleet.close()
+
+
+# ------------------------------------- per-forward deadlines (ISSUE 9 sat.)
+
+
+def test_read_timeout_raises_and_request_once_deadline():
+    """The transport half of the per-forward deadline: a conn whose peer
+    is alive but never answers raises the builtin TimeoutError from
+    request_once(timeout=) instead of blocking its caller forever."""
+    server = lsp.Server(0, PARAMS)
+    try:
+        c = lsp.Client("127.0.0.1", server.port, PARAMS)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            # The server accepts and keeps the conn alive (epochs) but no
+            # application ever writes a Result.
+            client_mod.request_once(c, "noanswer", 100, timeout=0.4)
+        assert time.monotonic() - t0 < 5.0
+        c.close()
+    finally:
+        server.close()
+
+
+def test_forward_timeout_unwedges_worker_and_falls_back_local():
+    """A wedged peer conn — transport alive, scheduler starved (no
+    miners) — used to block a forwarder worker in request_once forever,
+    head-of-line-blocking all forwarding on the replica.  With the
+    per-forward deadline the forward times out, counts
+    federation.forward_timeouts, and the request is served locally."""
+    METRICS.reset()
+    fleet = FedFleet(n=2, miners=0, forward_timeout=1.0, peer_down_ttl=0.1)
+    try:
+        data = next(
+            f"wedge{i}" for i in range(64)
+            if fleet.ring().home(f"wedge{i}") == "r1"
+        )
+        # Only the NON-home replica gets a miner: the home cell (r1) can
+        # accept the forwarded request but never answer it.
+        fleet.add_miner("r0")
+        want = min_hash_range(data, 0, 2000)
+        t0 = time.monotonic()
+        got = fleet.request_at("r0", data, 2000)
+        assert got == want
+        assert METRICS.get("federation.forward_timeouts") >= 1
+        assert METRICS.get("federation.local_fallbacks") >= 1
+        # A wedged-but-alive peer is a timeout, NOT a dead-replica
+        # failover — the two counters must not double-report.
+        assert METRICS.get("federation.forward_failovers") == 0
+        # Bounded by deadline + local sweep, not by a wedged read.
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        fleet.close()
+
+
+# --------------------------- admission identity across forwards (ISSUE 9)
+
+
+def test_forward_propagates_originating_admission_identity():
+    """Forwarded traffic must not pool under one fed:peer key at the home
+    cell: the forwarder sends the originating client key ahead of the
+    Request, and the home charges THAT identity's bucket/tenant."""
+    METRICS.reset()
+    fleet = FedFleet(n=2, rate=1000.0)
+    try:
+        data = next(
+            f"ident{i}" for i in range(64)
+            if fleet.ring().home(f"ident{i}") == "r1"
+        )
+        want = min_hash_range(data, 0, 1500)
+        assert fleet.request_at("r0", data, 1500) == want
+        home = fleet.replicas["r1"]
+        with home.lock:
+            keys = set(home.gateway._buckets)
+        # serve() binds public admission identity to the LSP peer addr;
+        # the forward carried it end-to-end.
+        assert "fed:addr:127.0.0.1" in keys, keys
+        assert "fed:peer" not in keys
+    finally:
+        fleet.close()

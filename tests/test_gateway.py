@@ -181,6 +181,93 @@ def make_gateway(**kw):
     return Gateway(Scheduler(**sched_kw), **kw)
 
 
+# ------------------------------------------------------- workload slice
+# A representative cache/span/coalesce slice parameterized over EVERY
+# registered range-fold workload (ISSUE 9), with result validation ON so
+# each workload's own oracle gates the folds: the serving layer is
+# workload-blind by construction, and this pins it.
+
+
+from bitcoin_miner_tpu import workloads as workloads_mod  # noqa: E402
+
+WORKLOAD_NAMES = workloads_mod.names()
+
+
+@pytest.mark.workloads
+@pytest.mark.parametrize("wname", WORKLOAD_NAMES)
+class TestWorkloadServingSlice:
+    def _gateway(self, wname, **sched_kw):
+        w = workloads_mod.get(wname)
+        sched_kw.setdefault("min_chunk", 100)
+        sched_kw.setdefault("max_chunk", 100)
+        return w, Gateway(Scheduler(workload=w, **sched_kw), rate=None)
+
+    def test_coalesced_twins_fan_out_validated(self, wname):
+        METRICS.reset()
+        w, g = self._gateway(wname)
+        g.miner_joined(1)
+        acts = g.client_request(10, DATA, 0, 99, now=0.0)
+        assert len(requests(acts)) == 1
+        assert g.client_request(11, DATA, 0, 99, now=0.0) == []  # coalesced
+        h, n = w.min_range(DATA, 0, 99)
+        done = results(g.result(1, hash_=h, nonce=n, now=1.0))
+        assert sorted(cid for cid, _ in done) == [10, 11]
+        assert all((m.hash, m.nonce) == (h, n) for _, m in done)
+        assert METRICS.get("gateway.coalesced") == 1
+        # A WRONG workload's answer for the same nonce must be rejected
+        # by this workload's validation (unless the families collide).
+        other = next(
+            (workloads_mod.get(o) for o in WORKLOAD_NAMES if o != wname)
+        )
+        bad = other.hash_nonce(DATA, 50)
+        if bad != w.hash_nonce(DATA, 50):
+            g2_w, g2 = self._gateway(wname)
+            g2.miner_joined(1)
+            g2.client_request(10, DATA, 0, 99, now=0.0)
+            assert results(g2.result(1, hash_=bad, nonce=50, now=1.0)) == []
+            assert METRICS.get("sched.results_rejected") == 1
+
+    def test_solved_job_cache_hit_zero_chunks(self, wname):
+        METRICS.reset()
+        w, g = self._gateway(wname)
+        g.miner_joined(1)
+        g.client_request(10, DATA, 0, 99, now=0.0)
+        h, n = w.min_range(DATA, 0, 99)
+        g.result(1, hash_=h, nonce=n, now=1.0)
+        assigned = METRICS.get("sched.chunks_assigned")
+        acts = g.client_request(20, DATA, 0, 99, now=2.0)
+        assert results(acts) == [(20, acts[0][1])]
+        assert (acts[0][1].hash, acts[0][1].nonce) == (h, n)
+        assert METRICS.get("sched.chunks_assigned") == assigned
+        assert METRICS.get("gateway.cache_hits") == 1
+
+    def test_covered_subrange_span_answer(self, wname):
+        METRICS.reset()
+        w, g = self._gateway(wname)
+        g.miner_joined(1, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        # Three validated 100-nonce chunks; each span's fold is this
+        # workload's true per-chunk argmin, so every span is answerable
+        # for any query containing its argmin.
+        folds = [w.min_range(DATA, lo, lo + 99) for lo in (0, 100, 200)]
+        for t, (h, n) in enumerate(folds):
+            g.result(1, hash_=h, nonce=n, now=1.0 + t)
+        assigned = METRICS.get("sched.chunks_assigned")
+        qlo = min(n for _h, n in folds)
+        qhi = max(n for _h, n in folds)
+        if (qlo, qhi) == (0, 299):
+            pytest.skip("degenerate argmin geometry for this workload/data")
+        acts = g.client_request(20, DATA, qlo, qhi, now=5.0)
+        got = results(acts)
+        assert got, "covered sub-range should answer from spans"
+        want = min(folds)
+        assert (got[0][1].hash, got[0][1].nonce) == want
+        assert METRICS.get("sched.chunks_assigned") == assigned
+        assert METRICS.get("gateway.span_hits") == 1
+        # Bit-exact against the workload's own oracle over the sub-range.
+        assert want == w.min_range(DATA, qlo, qhi)
+
+
 class TestCoalescing:
     def test_twin_requests_share_one_sweep_and_fan_out(self):
         METRICS.reset()

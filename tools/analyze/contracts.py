@@ -14,6 +14,12 @@ recomputes and compares, no network, no device:
   ``null`` nil-payload convention.
 - **bitcoin/hash**: ``Hash(msg, nonce)`` vectors (single SHA-256 over
   ``"<msg> <nonce>"``, big-endian first 8 bytes).
+- **workload registry** (ISSUE 9): every registered workload's own
+  golden vectors are recomputed through its ``hash_nonce`` — the same
+  pin the reference contract gets, so no workload's hash family can
+  drift silently; the DEFAULT workload must additionally agree with the
+  reference ``bitcoin/hash`` vectors byte-for-byte (the sha256d path is
+  the frozen contract, registry or not).
 - **CLI stdout**: the usage strings (driven through ``main()`` with a
   wrong argc) and the literal ``Result``/``Disconnected``/``Server
   listening`` prints, pinned at source level.
@@ -195,6 +201,84 @@ def _check_codec(
             )
 
 
+#: Every registered workload must pin at least this many golden vectors.
+WORKLOAD_MIN_GOLDEN = 3
+
+#: The registry's frozen default must agree with the reference hash
+#: contract — the rest of the checker pins that name's behavior.
+WORKLOAD_DEFAULT_NAME = "sha256d"
+
+_WORKLOADS_PATH = "bitcoin_miner_tpu/workloads/__init__.py"
+
+
+def _check_workloads(findings: List[Finding]) -> None:
+    """The per-workload golden-vector pass (ISSUE 9): recompute every
+    registered workload's pinned vectors, require a minimum pin count,
+    and hold the default to the reference contract."""
+    from bitcoin_miner_tpu import workloads
+
+    if workloads.DEFAULT_WORKLOAD != WORKLOAD_DEFAULT_NAME:
+        findings.append(
+            Finding(
+                PASS, "workload-default", _WORKLOADS_PATH, 1,
+                workloads.DEFAULT_WORKLOAD,
+                f"registry default drifted from the frozen "
+                f"{WORKLOAD_DEFAULT_NAME!r}",
+            )
+        )
+    for name in workloads.names():
+        w = workloads.get(name)
+        if len(w.golden) < WORKLOAD_MIN_GOLDEN:
+            findings.append(
+                Finding(
+                    PASS, "workload-golden-missing", _WORKLOADS_PATH, 1, name,
+                    f"workload pins only {len(w.golden)} golden vectors "
+                    f"(need >= {WORKLOAD_MIN_GOLDEN}) — an unpinned hash "
+                    "family can drift silently",
+                )
+            )
+        for data, nonce, frozen in w.golden:
+            try:
+                got = w.hash_nonce(data, nonce)
+            except Exception as e:  # a crash IS a contract break
+                findings.append(
+                    Finding(
+                        PASS, "workload-vector", _WORKLOADS_PATH, 1,
+                        f"{name}({data!r},{nonce})", f"hash_nonce raised {e!r}",
+                    )
+                )
+                continue
+            if got != frozen:
+                findings.append(
+                    Finding(
+                        PASS, "workload-vector", _WORKLOADS_PATH, 1,
+                        f"{name}({data!r},{nonce})",
+                        f"drifted: {got} != frozen {frozen}",
+                    )
+                )
+    # The default's oracle must equal the reference contract itself.
+    try:
+        w = workloads.get(WORKLOAD_DEFAULT_NAME)
+    except ValueError:
+        findings.append(
+            Finding(
+                PASS, "workload-default", _WORKLOADS_PATH, 1,
+                WORKLOAD_DEFAULT_NAME, "frozen default not registered",
+            )
+        )
+        return
+    for msg, nonce, frozen in HASH_VECTORS:
+        if w.hash_nonce(msg, nonce) != frozen:
+            findings.append(
+                Finding(
+                    PASS, "workload-default", _WORKLOADS_PATH, 1,
+                    f"{WORKLOAD_DEFAULT_NAME}({msg!r},{nonce})",
+                    "default workload disagrees with the reference "
+                    "bitcoin/hash contract vectors",
+                )
+            )
+
+
 def run(
     root: Path,
     scan_dirs: Any = None,
@@ -237,6 +321,9 @@ def run(
                         f"drifted: {got} != frozen {frozen}",
                     )
                 )
+
+    if not fixture_mode:
+        _check_workloads(findings)
 
     for binary, frozen in USAGE:
         mod = mods.get(binary)

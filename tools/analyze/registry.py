@@ -32,6 +32,13 @@ EXTERNAL_CLASSES = (
     ("bitcoin_miner_tpu/utils/intervals.py", "IntervalMap"),
     ("bitcoin_miner_tpu/federation/gossip.py", "GossipSpanStore"),
     ("bitcoin_miner_tpu/federation/ring.py", "Ring"),
+    # Workloads (ISSUE 9) are stateless policy shared read-only by every
+    # thread of a process: they must never grow locks or threads (their
+    # device-tier factories may RETURN threaded machinery — SweepPipeline
+    # et al. — but the workload object itself stays inert).
+    ("bitcoin_miner_tpu/workloads/base.py", "Workload"),
+    ("bitcoin_miner_tpu/workloads/sha256.py", "Sha256Workload"),
+    ("bitcoin_miner_tpu/workloads/blake2b.py", "Blake2bWorkload"),
 )
 
 #: Internally-locked classes expected to carry ``# guarded-by:`` field

@@ -62,7 +62,13 @@ PASS = "trace"
 FACTORY_RE = re.compile(r"(make|build).*(kernel|minhash|call)")
 
 #: Default scan scope in repo mode: the accelerator layers.
-TRACE_SCAN_DIRS = ("bitcoin_miner_tpu/ops", "bitcoin_miner_tpu/parallel")
+TRACE_SCAN_DIRS = (
+    "bitcoin_miner_tpu/ops",
+    "bitcoin_miner_tpu/parallel",
+    # Workload kernel factories (ISSUE 9): any jit/factory-pattern kernel
+    # body a registered workload ships is linted like ops/ and parallel/.
+    "bitcoin_miner_tpu/workloads",
+)
 
 _TRACED_MODULES = ("jnp", "lax")
 _LAUNDER_ATTRS = {"shape", "dtype", "ndim", "size"}

@@ -55,11 +55,19 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
 
-from bitcoin_miner_tpu.bitcoin.hash import hash_nonce  # noqa: E402
+from bitcoin_miner_tpu import workloads as workloads_mod  # noqa: E402
 from bitcoin_miner_tpu.bitcoin.message import Message, MsgType  # noqa: E402
 from bitcoin_miner_tpu.utils.metrics import Histogram  # noqa: E402
 
 REPO = Path(__file__).resolve().parents[1]
+
+#: The resolved range-fold workload (ISSUE 9).  main() resolves it
+#: AFTER argparse — ``--workload`` first, env BMT_WORKLOAD second — and
+#: exports the env so the server/miner/federation subprocesses serve
+#: the same hash family this tool's oracle validates against.  Import
+#: time pins the default only: a stale BMT_WORKLOAD must not kill
+#: ``--help`` (or a valid flag) before the parser ever runs.
+WORKLOAD = workloads_mod.resolve(None)
 
 #: Request→result latency of every job this bench ran (warm-ups, class
 #: warms, timed, drills) — p50/p95/p99 land in the BENCH JSON line so the
@@ -237,7 +245,9 @@ def run_job(
     # kernel tiers are oracle-tested.  Assert the returned pair is at
     # least a real in-range hash of the job.
     assert lower <= msg.nonce <= max_nonce, (msg.nonce, lower, max_nonce)
-    assert hash_nonce(data, msg.nonce) == msg.hash, (msg.hash, msg.nonce)
+    assert WORKLOAD.hash_nonce(data, msg.nonce) == msg.hash, (
+        msg.hash, msg.nonce, WORKLOAD.name,
+    )
     return {
         "wall_s": dt,
         "hash": msg.hash,
@@ -402,8 +412,9 @@ def run_federation_bench(args) -> int:
     Prints one JSON line."""
     import random
 
-    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
     from bitcoin_miner_tpu.federation.ring import Ring
+
+    min_hash_range = WORKLOAD.min_range
 
     n = max(2, args.federation)
     names = [f"r{i}" for i in range(n)]
@@ -520,6 +531,7 @@ def run_federation_bench(args) -> int:
                     "metric": "federation_fleet_jobs_per_sec",
                     "value": round(rate_n, 3),
                     "unit": "jobs/s",
+                    "workload": WORKLOAD.name,
                     "replicas": n,
                     # Scaling is bounded by the host: N cells can only
                     # compute in parallel up to the core count.
@@ -592,6 +604,15 @@ def main() -> int:
         default=10.0,
         help="replay period for the --chaos scenario (seconds)",
     )
+    ap.add_argument(
+        "--workload",
+        default=None,
+        metavar="NAME",
+        help="registered range-fold workload to bench (ISSUE 9); exported "
+        "as BMT_WORKLOAD to the server/miner/federation subprocesses so "
+        "the whole fleet serves one hash family; default: the frozen "
+        "sha256d contract",
+    )
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument(
@@ -633,6 +654,18 @@ def main() -> int:
         "own JSON line and exits",
     )
     args = ap.parse_args()
+
+    global WORKLOAD
+    try:
+        WORKLOAD = workloads_mod.resolve(
+            args.workload or os.environ.get("BMT_WORKLOAD") or None
+        )
+    except ValueError as e:
+        ap.error(str(e))
+    if args.workload:
+        # Subprocess fleets (MinerKeeper, server, federation cells) all
+        # spawn with {**os.environ}: one export reaches every process.
+        os.environ["BMT_WORKLOAD"] = WORKLOAD.name
 
     if args.federation:
         return run_federation_bench(args)
@@ -832,6 +865,7 @@ def main() -> int:
                     "metric": "fleet_nonces_per_sec",
                     "value": round(rate),
                     "unit": "nonces/s",
+                    "workload": WORKLOAD.name,
                     "vs_baseline": round(rate / 1e9, 4),
                     "kernel_rate": round(args.kernel_rate),
                     "vs_kernel": round(rate / args.kernel_rate, 4),

@@ -126,7 +126,7 @@ def run_leg(
 
     params = lsp.Params(epoch_limit=5, epoch_millis=200, window_size=5)
     server = lsp.Server(0, params)
-    sched = Scheduler(min_chunk=args.min_chunk)
+    sched = Scheduler(min_chunk=args.min_chunk, workload=args.wl)
     engine = (
         Gateway(
             sched,
@@ -146,7 +146,7 @@ def run_leg(
         kwargs={"tick_interval": 0.05},
         daemon=True,
     ).start()
-    search = miner_mod.make_search("cpu")
+    search = miner_mod.make_search("cpu", workload=args.wl)
     for _ in range(args.miners):
         mc = lsp.Client("127.0.0.1", server.port, params)
         threading.Thread(
@@ -218,7 +218,7 @@ def run_leg(
             errors.append("repeat probe assigned chunks (cache missed)")
     if gateway_on and spans_on and not errors:
         subrange_zero_chunks = _subrange_probe(
-            engine, server, params, jobs, errors
+            engine, server, params, jobs, errors, args.oracle_fn
         )
 
     server.close()
@@ -250,15 +250,15 @@ def run_leg(
     }
 
 
-def _subrange_probe(engine, server, params, jobs, errors):
+def _subrange_probe(engine, server, params, jobs, errors, oracle_fn):
     """The ISSUE 5 acceptance probe: find a NEVER-ISSUED strict sub-range
     of the widest solved signature that the interval store fully covers,
     request it over the wire, and assert it answers bit-exact with zero
     chunks assigned (mirroring the exact-repeat `repeat_zero_chunks`
-    probe)."""
+    probe).  ``oracle_fn`` is the selected workload's hashlib-tier
+    min-range oracle."""
     from bitcoin_miner_tpu import lsp
     from bitcoin_miner_tpu.apps import client as client_mod
-    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
     from bitcoin_miner_tpu.utils.metrics import METRICS
 
     issued = set(jobs)
@@ -295,7 +295,7 @@ def _subrange_probe(engine, server, params, jobs, errors):
         got = client_mod.request_once(c, data, sub[1], lower=sub[0])
     finally:
         c.close()
-    want = min_hash_range(data, sub[0], sub[1])
+    want = oracle_fn(data, sub[0], sub[1])
     if got != want:
         errors.append(
             f"subrange probe ({data},{sub[0]},{sub[1]}): got {got}, want {want}"
@@ -338,7 +338,6 @@ def run_federation_leg(n_replicas: int, jobs: list, args, oracle: dict) -> dict:
     from bitcoin_miner_tpu.apps import client as client_mod
     from bitcoin_miner_tpu.apps import miner as miner_mod
     from bitcoin_miner_tpu.apps.scheduler import Scheduler
-    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
     from bitcoin_miner_tpu.federation import Replica, Ring
     from bitcoin_miner_tpu.utils.metrics import METRICS, Histogram
 
@@ -358,12 +357,13 @@ def run_federation_leg(n_replicas: int, jobs: list, args, oracle: dict) -> dict:
                 peers,
                 fed_port=fed_ports[name],
                 params=params,
-                scheduler=Scheduler(min_chunk=args.min_chunk),
+                scheduler=Scheduler(min_chunk=args.min_chunk, workload=args.wl),
                 gossip_interval=0.2,
                 tick_interval=0.05,
+                workload=args.wl,
             ).start()
         )
-    search = miner_mod.make_search("cpu")
+    search = miner_mod.make_search("cpu", workload=args.wl)
     for rep in replicas:
         for _ in range(args.miners):
             mc = lsp.Client("127.0.0.1", rep.port, params)
@@ -445,7 +445,7 @@ def run_federation_leg(n_replicas: int, jobs: list, args, oracle: dict) -> dict:
             repeat_zero = False
     if not errors and n_replicas > 1:
         cross_zero = _cross_replica_probe(
-            replicas, params, jobs, oracle, errors, min_hash_range, Ring,
+            replicas, params, jobs, oracle, errors, args.oracle_fn, Ring,
             METRICS,
         )
 
@@ -482,7 +482,7 @@ def run_federation_leg(n_replicas: int, jobs: list, args, oracle: dict) -> dict:
 
 
 def _cross_replica_probe(
-    replicas, params, jobs, oracle, errors, min_hash_range, Ring, METRICS
+    replicas, params, jobs, oracle, errors, oracle_fn, Ring, METRICS
 ):
     """The ISSUE 8 acceptance probe: a never-issued sub-range of solved
     work, fully covered BY GOSSIP on a replica that is NOT the data's
@@ -534,7 +534,7 @@ def _cross_replica_probe(
         got = client_mod.request_once(c, data, sub[1], lower=sub[0])
     finally:
         c.close()
-    want = min_hash_range(data, sub[0], sub[1])
+    want = oracle_fn(data, sub[0], sub[1])
     if got != want:
         errors.append(
             f"cross-replica probe ({data},{sub[0]},{sub[1]}) on "
@@ -588,6 +588,10 @@ def main(argv=None) -> int:
                          "FIRST (same leg-order discipline as "
                          "--trace-overhead: warmup bias inflates, never "
                          "masks, the ISSUE 7 <5%% acceptance number)")
+    ap.add_argument("--workload", default=None, metavar="NAME",
+                    help="registered range-fold workload to serve/bench "
+                         "(ISSUE 9; default: the frozen sha256d contract; "
+                         "env BMT_WORKLOAD)")
     ap.add_argument("--fast", action="store_true",
                     help="tier-1 preset: small jobs, done in well under 30 s")
     args = ap.parse_args(argv)
@@ -607,7 +611,22 @@ def main(argv=None) -> int:
         args.max_nonce = min(args.max_nonce, 4000)
         args.timeout = min(args.timeout, 60.0)
 
-    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+    import os
+
+    from bitcoin_miner_tpu import workloads as workloads_mod
+
+    try:
+        wl = workloads_mod.resolve(
+            args.workload or os.environ.get("BMT_WORKLOAD") or None
+        )
+    except ValueError as e:
+        ap.error(str(e))
+    # None = the frozen default's byte-identical scheduler/miner paths;
+    # the JSON line always stamps the resolved name so trajectories with
+    # different workloads never get compared as one series.
+    args.wl = workloads_mod.resolve_nondefault(wl)
+    args.wl_name = wl.name
+    args.oracle_fn = min_hash_range = wl.min_range
 
     if args.federation:
         # Overlap-heavy workload over a wider key family, so the ring has
@@ -720,6 +739,7 @@ def main(argv=None) -> int:
         "metric": "loadgen_jobs_per_sec",
         "value": round(gw["jobs_per_sec"], 3),
         "unit": "jobs/s",
+        "workload": args.wl_name,
         "clients": args.clients,
         "jobs": len(jobs),
         "distinct_signatures": len(distinct),
@@ -801,6 +821,7 @@ def _federation_main(jobs, distinct, args, oracle) -> int:
         "metric": "loadgen_federation_jobs_per_sec",
         "value": round(fed["jobs_per_sec"], 3),
         "unit": "jobs/s",
+        "workload": args.wl_name,
         "mode": "federation",
         "replicas": n,
         "clients": args.clients,
@@ -852,6 +873,7 @@ def _overlap_main(jobs, distinct, args, oracle) -> int:
         "metric": "loadgen_overlap_jobs_per_sec",
         "value": round(spans["jobs_per_sec"], 3),
         "unit": "jobs/s",
+        "workload": args.wl_name,
         "mode": "overlap",
         "clients": args.clients,
         "jobs": len(jobs),
