@@ -21,6 +21,32 @@ Design notes (deliberately not a translation of anything):
   ``min_chunk`` and ramp as rates are observed; a geometric boost
   (``ramp_factor``× the last chunk while chunks complete in under half the
   target) shortens the cold ramp from ~15 round-trips to ~6.
+- **10^k-aligned size ladder** (ISSUE 10, default on): once a miner's
+  rate is known, its chunk size snaps to the power-of-ten rung nearest
+  ``rate × target_chunk_seconds`` in log space, and chunk boundaries are
+  cut on multiples of that rung.  Why aligned: digit generation in the
+  device kernels is iota-based — sweep chunks are 10^k-aligned so the
+  high digits are per-chunk constants folded into the message template
+  host-side (ops/sha256.py) — so rung-aligned scheduler chunks decompose
+  into FULL device dispatch rows instead of runt-bounded ones.  A rung
+  only moves when the ideal size drifts past the rung midpoint by a
+  hysteresis margin (``sched.chunk_size_adapt`` counts moves), so sizes
+  don't oscillate between adjacent decades on EWMA noise.
+  ``adaptive_chunks=False`` restores the continuous legacy sizing (the
+  static-chunk comparison leg pins ``min_chunk == max_chunk`` on top).
+- **Straggler tail re-dispatch (work stealing)** (ISSUE 10): the full
+  straggler re-queue below waits ``straggler_factor``× the slow miner's
+  OWN expected chunk time — a consistently slow miner never trips it
+  early.  The steal scan instead compares a running chunk's age against
+  the FLEET's recent chunk-time p50: past ``steal_factor``× that (or an
+  explicit :meth:`mark_straggler` from the PR-7 fleet detector), an idle
+  miner is handed the *tail* of the outstanding interval.  First
+  completed sub-interval wins; the straggler's eventual full-interval
+  Result folds harmlessly (min over a superset) and withdraws whatever
+  duplicate is still pending — the same interval-subtraction bookkeeping
+  the straggler re-queue uses, so split-on-steal stays bit-exact
+  (property-tested against from-scratch sweeps).  A steal-flagged miner
+  gets no new work until it answers or dies.
 - **Pipelined assignment** (``pipeline_depth``, default 2): each miner
   holds up to depth outstanding chunks, results matched FIFO (LSP delivers
   in order and the miner processes in order).  Why: on tunnelled TPUs one
@@ -63,6 +89,7 @@ Design notes (deliberately not a translation of anything):
 
 from __future__ import annotations
 
+import math
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
@@ -91,6 +118,11 @@ class _Asgn:
     assigned_at: float
     started_at: float  # when it reached the queue front (rate/straggler base)
     timed_out: bool = False  # reclaimed by the straggler tick
+    # Tail handed to an idle miner by the steal scan (ISSUE 10): the
+    # holder still owes a Result for the WHOLE interval (its argmin may
+    # land anywhere in it), so the interval stays intact for validation
+    # and only this record marks which portion is duplicated elsewhere.
+    stolen: Optional[Interval] = None
 
 
 @dataclass
@@ -101,6 +133,7 @@ class _Miner:
     rejects: int = 0  # invalid Results so far (strikes)
     last_size: int = 0  # last completed chunk (geometric ramp boost)
     last_elapsed: float = 0.0
+    rung: Optional[int] = None  # 10^rung size class (adaptive ladder)
 
     # Front-of-queue views: the chunk the miner is computing NOW (the rest
     # of the queue is transport-buffered, not started).
@@ -137,6 +170,10 @@ class _Job:
     # one trace reconstructs the job's whole timeline.
     trace: Optional[int] = None
     t0: float = 0.0
+    # Speculative span-prefill job (ISSUE 10): accounting only — the
+    # gateway owns the policy; the flag routes chunk counts to
+    # ``sched.prefill_chunks`` and keeps the steal scan off it.
+    prefill: bool = False
 
     def fold(self, hash_: int, nonce: int) -> None:
         cand = (hash_, nonce)
@@ -174,6 +211,11 @@ class Scheduler:
         max_rejects: int = 3,
         straggler_factor: float = 4.0,
         straggler_min_seconds: float = 10.0,
+        adaptive_chunks: bool = True,
+        rung_hysteresis: float = 0.15,
+        steal_factor: float = 2.0,
+        steal_min_seconds: float = 2.0,
+        steal_min_samples: int = 4,
         pipeline_depth: int = 2,
         ramp_factor: int = 8,
         orphan_cache_max: int = 256,
@@ -202,6 +244,19 @@ class Scheduler:
         self.max_rejects = max_rejects
         self.straggler_factor = straggler_factor
         self.straggler_min_seconds = straggler_min_seconds
+        # Adaptive dispatch plane (ISSUE 10): the 10^k size ladder and the
+        # straggler-tail steal scan.  steal_factor <= 0 disables stealing;
+        # adaptive_chunks=False restores the continuous legacy sizing.
+        self.adaptive_chunks = adaptive_chunks
+        self.rung_hysteresis = rung_hysteresis
+        self.steal_factor = steal_factor
+        self.steal_min_seconds = steal_min_seconds
+        self.steal_min_samples = max(1, steal_min_samples)
+        # Recent accepted-chunk service times, fleet-wide: the steal
+        # scan's p50 evidence.  Self-contained (not the process METRICS
+        # histogram) so the pure scheduler stays deterministic in tests.
+        self._recent_chunk_s: Deque[float] = deque(maxlen=64)
+        self._marked_stragglers: set = set()  # external (fleet-plane) naming
         self.pipeline_depth = pipeline_depth
         self.ramp_factor = ramp_factor
         self.orphan_cache_max = orphan_cache_max
@@ -252,6 +307,7 @@ class Scheduler:
         gaps: Optional[List[Interval]] = None,
         seed_best: Optional[Tuple[int, int]] = None,
         trace: Optional[int] = None,
+        prefill: bool = False,
     ) -> List[Action]:
         """``tenant``/``weight`` name the fair-queue principal this job is
         charged to (the gateway passes its per-client key); default is the
@@ -279,13 +335,14 @@ class Scheduler:
         job = _Job(
             client_id=conn_id, data=data, lower=lower, upper=upper,
             tenant=tenant or f"conn:{conn_id}",
-            trace=trace, t0=now,
+            trace=trace, t0=now, prefill=prefill,
         )
         _trace.emit(
             trace, "sched", "job_start",
             conn=conn_id, data=data[:64], lower=lower, upper=upper,
             tenant=tenant or f"conn:{conn_id}",
             gaps=len(gaps) if gaps is not None else None,
+            prefill=prefill or None,
         )
         if seed_best is not None:
             job.fold(seed_best[0], seed_best[1])
@@ -349,6 +406,12 @@ class Scheduler:
         )
         miner.last_size = size
         miner.last_elapsed = elapsed
+        # Fleet-wide recent chunk times: the steal scan's p50 evidence.
+        self._recent_chunk_s.append(elapsed)
+        # A valid answer clears any external straggler mark ("until it
+        # answers or dies"): a mark that found no idle thief at the time
+        # must not linger and steal from a fresh, healthy chunk later.
+        self._marked_stragglers.discard(conn_id)
         # Server-side throughput surface: every accepted chunk's nonces.
         # The ticker's sliding-window RateMeter over this counter is the
         # health line's "recent nonces/sec" (utils/metrics.RateMeter).
@@ -375,19 +438,24 @@ class Scheduler:
                 if len(self._span_export) > self.span_export_max:
                     del self._span_export[0]
             job.remove_outstanding(conn_id, front.interval)
-            if front.timed_out:
+            if front.timed_out or front.stolen is not None:
                 # The slow miner finished after all: withdraw whatever of
-                # its re-queued duplicate is still pending.  Dispatch may
-                # have split the duplicate into differently-shaped chunks,
+                # its re-queued duplicates is still pending.  Dispatch may
+                # have split a duplicate into differently-shaped chunks,
                 # so subtract the interval rather than matching it whole
                 # (parts already handed to other miners are re-swept; the
-                # min-fold makes that harmless).
+                # min-fold makes that harmless).  Duplicates of this front
+                # are any recorded sub-interval: the whole chunk (straggler
+                # re-queue), its stolen tail, or its post-steal head.
                 dups = job.requeued.get(conn_id)
-                if dups and front.interval in dups:
-                    dups.remove(front.interval)
+                if dups:
+                    for iv in [
+                        iv for iv in dups if lo <= iv[0] and iv[1] <= hi
+                    ]:
+                        dups.remove(iv)
+                        _subtract_pending(job, iv)
                     if not dups:
                         del job.requeued[conn_id]
-                    _subtract_pending(job, front.interval)
             job.fold(hash_, nonce)
             if job.done:
                 actions.append(self._finish_job(job, now))
@@ -409,10 +477,18 @@ class Scheduler:
                     continue
                 job.remove_outstanding(conn_id, asgn.interval)
                 if not asgn.timed_out:
-                    job.pending.appendleft(asgn.interval)
-                    METRICS.inc("sched.chunks_reassigned")
+                    iv = asgn.interval
+                    if asgn.stolen is not None:
+                        # The stolen tail is already live elsewhere
+                        # (pending or at the thief); only the unstolen
+                        # head returns.
+                        iv = (iv[0], asgn.stolen[0] - 1)
+                    if iv[0] <= iv[1]:
+                        job.pending.appendleft(iv)
+                        METRICS.inc("sched.chunks_reassigned")
             for job in self.jobs.values():
                 job.requeued.pop(conn_id, None)
+            self._marked_stragglers.discard(conn_id)
             return self._dispatch(now)
         job = self.jobs.pop(conn_id, None)
         if job is not None:
@@ -440,7 +516,11 @@ class Scheduler:
                 job.trace, "sched", "job_orphaned",
                 remaining=len(remaining), had_best=job.best is not None,
             )
-            if remaining or job.best is not None:
+            # Speculative prefill jobs never stash: their completed chunks
+            # are already solved spans, nobody resubmits their synthetic
+            # key, and the bounded FIFO (+ checkpoint it feeds) must not
+            # evict a real dead client's resume progress for speculation.
+            if (remaining or job.best is not None) and not job.prefill:
                 _merge_progress(self._resume, job.key, job.best, remaining)
                 METRICS.inc("sched.jobs_orphaned")
                 while len(self._resume) > self.orphan_cache_max:
@@ -478,8 +558,14 @@ class Scheduler:
                 continue
             asgn.timed_out = True
             job.remove_outstanding(miner.conn_id, asgn.interval)
-            job.pending.appendleft(asgn.interval)
-            job.requeued.setdefault(miner.conn_id, []).append(asgn.interval)
+            # A chunk whose tail was already stolen re-queues only the
+            # head — the tail copy is live elsewhere since the steal.
+            iv = asgn.interval
+            if asgn.stolen is not None:
+                iv = (iv[0], asgn.stolen[0] - 1)
+            if iv[0] <= iv[1]:
+                job.pending.appendleft(iv)
+                job.requeued.setdefault(miner.conn_id, []).append(iv)
             # The successor's straggler clock starts now — it could not
             # have been computing while its predecessor wedged the miner.
             nxt = next((a for a in miner.queue if not a.timed_out), None)
@@ -492,7 +578,74 @@ class Scheduler:
             )
             self.revision += 1
             reclaimed = True
+        if self.steal_factor and self.steal_factor > 0:
+            reclaimed = self._steal_scan(now) or reclaimed
         return self._dispatch(now) if reclaimed else []
+
+    def mark_straggler(self, conn_id: int) -> None:
+        """External straggler signal (the PR-7 fleet detector's
+        leave-one-out naming, or a drill): the next :meth:`tick` steals
+        this miner's running chunk's tail regardless of the fleet-p50 age
+        heuristic — provided an idle miner exists to take it."""
+        if conn_id in self.miners:
+            self._marked_stragglers.add(conn_id)
+
+    def _steal_scan(self, now: float) -> bool:
+        """Hand the tails of straggling chunks to idle miners (module
+        docstring: straggler tail re-dispatch).  Age evidence is the
+        FLEET's recent chunk-time p50 — a slow miner's own expected time
+        would never flag it — gated on ``steal_min_samples`` so a cold
+        fleet never steals on guesses.  One steal per idle miner per
+        tick; a stolen front is never re-stolen (the full straggler
+        re-queue is the escalation)."""
+        idle = sum(1 for m in self.miners.values() if not m.queue)
+        if idle == 0:
+            return False
+        p50: Optional[float] = None
+        if len(self._recent_chunk_s) >= self.steal_min_samples:
+            srt = sorted(self._recent_chunk_s)
+            p50 = srt[len(srt) // 2]
+        stole = False
+        for miner in self.miners.values():
+            if idle == 0:
+                break
+            if not miner.queue:
+                continue
+            asgn = miner.queue[0]
+            if asgn.timed_out or asgn.stolen is not None:
+                continue
+            lo, hi = asgn.interval
+            if hi - lo < 1:
+                continue  # single nonce: nothing to split
+            job = self.jobs.get(asgn.job)
+            if job is None or job.prefill:
+                continue  # speculative work is not worth duplicating
+            if miner.conn_id not in self._marked_stragglers:
+                if p50 is None:
+                    continue
+                deadline = asgn.started_at + max(
+                    self.steal_factor * p50, self.steal_min_seconds
+                )
+                if now < deadline:
+                    continue
+            self._marked_stragglers.discard(miner.conn_id)
+            # Steal the upper half: the straggler sweeps low nonces first
+            # (decompose_range ascends), so the tail is the portion it is
+            # least likely to have reached.
+            mid = lo + (hi - lo) // 2
+            tail = (mid + 1, hi)
+            asgn.stolen = tail
+            job.pending.appendleft(tail)
+            job.requeued.setdefault(miner.conn_id, []).append(tail)
+            idle -= 1
+            METRICS.inc("sched.steals")
+            _trace.emit(
+                job.trace, "sched", "steal",
+                miner=miner.conn_id, lo=tail[0], hi=tail[1],
+            )
+            self.revision += 1
+            stole = True
+        return stole
 
     # ------------------------------------------------------------------ checkpoint
 
@@ -503,6 +656,12 @@ class Scheduler:
         """
         merged: Dict[JobKey, Tuple[Optional[Tuple[int, int]], List[Interval]]] = {}
         for job in self.jobs.values():
+            if job.prefill:
+                # Speculative work never checkpoints: its completed chunks
+                # are already solved spans (the spans file persists those)
+                # and nobody ever resubmits the synthetic key, so an entry
+                # would only squat in the bounded resume stash on restore.
+                continue
             remaining = list(job.pending) + [
                 iv for lst in job.outstanding.values() for iv in lst
             ]
@@ -563,11 +722,15 @@ class Scheduler:
         miner.rejects += 1
         front = miner.queue.popleft()
         job.remove_outstanding(miner.conn_id, front.interval)
-        if front.timed_out:
-            # Chunk already re-queued by the straggler tick; keep that copy.
+        if front.timed_out or front.stolen is not None:
+            # Copies re-queued by the straggler tick / steal scan stand —
+            # they are now the ONLY live copies — but their withdrawal
+            # records must go: no valid Result can arrive for this front.
             dups = job.requeued.get(miner.conn_id)
-            if dups and front.interval in dups:
-                dups.remove(front.interval)
+            if dups:
+                flo, fhi = front.interval
+                for iv in [iv for iv in dups if flo <= iv[0] and iv[1] <= fhi]:
+                    dups.remove(iv)
                 if not dups:
                     del job.requeued[miner.conn_id]
         if miner.queue:
@@ -576,6 +739,7 @@ class Scheduler:
         if evicted:
             METRICS.inc("sched.miners_evicted")
             del self.miners[miner.conn_id]
+            self._marked_stragglers.discard(miner.conn_id)
         # Re-queue front first, then (on eviction) its queued successors —
         # one reversed pass over [front, *queue] keeps low nonces first
         # (same order rule as lost()).
@@ -586,7 +750,11 @@ class Scheduler:
                 continue
             if asgn is not front:
                 j.remove_outstanding(miner.conn_id, asgn.interval)
-            j.pending.appendleft(asgn.interval)
+            iv = asgn.interval
+            if asgn.stolen is not None:
+                iv = (iv[0], asgn.stolen[0] - 1)  # tail copy already live
+            if iv[0] <= iv[1]:
+                j.pending.appendleft(iv)
         if evicted:
             # No Result can ever arrive from the banned conn: drop its
             # stale straggler-withdrawal records (same hygiene as lost()).
@@ -610,9 +778,9 @@ class Scheduler:
 
     def _chunk_size(self, miner: _Miner) -> int:
         if miner.rate <= 0.0:
-            size = self.min_chunk
-        else:
-            size = int(miner.rate * self.target_chunk_seconds)
+            miner.rung = None  # cold (or re-cold) miner: ladder re-seats
+            return self.min_chunk
+        size = int(miner.rate * self.target_chunk_seconds)
         # Geometric ramp boost: while chunks complete in well under the
         # target, the EWMA (which includes per-chunk latency) understates
         # the miner — probe ramp_factor× the last chunk so a TPU reaches
@@ -622,7 +790,24 @@ class Scheduler:
             and miner.last_elapsed < self.target_chunk_seconds / 2
         ):
             size = max(size, miner.last_size * self.ramp_factor)
-        return max(self.min_chunk, min(size, self.max_chunk))
+        if not self.adaptive_chunks:
+            return max(self.min_chunk, min(size, self.max_chunk))
+        # 10^k size ladder (module docstring): snap to the rung nearest
+        # the ideal size in log space, moving only past a hysteresis
+        # margin beyond the rung midpoint so EWMA noise cannot oscillate
+        # a miner between adjacent decades.
+        ideal = max(1, min(size, self.max_chunk))
+        lg = math.log10(ideal)
+        if (
+            miner.rung is None
+            or abs(lg - miner.rung) > 0.5 + self.rung_hysteresis
+        ):
+            rung = round(lg)
+            if rung != miner.rung:
+                if miner.rung is not None:
+                    METRICS.inc("sched.chunk_size_adapt")
+                miner.rung = rung
+        return max(self.min_chunk, min(10 ** miner.rung, self.max_chunk))
 
     def _tenant_add(self, key: str, conn_id: int, weight: float) -> None:
         # Floor init, weight update and tie-break seq all live in the
@@ -660,14 +845,15 @@ class Scheduler:
         # a re-queued chunk should land on a trustworthy peer, not bounce
         # back to the liar.
         for level in range(self.pipeline_depth):
-            # A miner holding a timed-out (straggler-reclaimed) chunk is
-            # presumed hung: no new work until it answers or dies —
-            # otherwise its own re-queued duplicate bounces back to it.
+            # A miner holding a timed-out (straggler-reclaimed) or
+            # steal-flagged chunk is presumed hung/slow: no new work until
+            # it answers or dies — otherwise its own re-queued duplicate
+            # (or stolen tail) bounces back to it.
             ready = [
                 m
                 for m in self.miners.values()
                 if len(m.queue) == level
-                and not any(a.timed_out for a in m.queue)
+                and not any(a.timed_out or a.stolen is not None for a in m.queue)
             ]
             ready.sort(key=lambda m: (m.rejects, -m.rate))
             for miner in ready:
@@ -677,6 +863,16 @@ class Scheduler:
                 lo, hi = job.pending.popleft()
                 size = self._chunk_size(miner)
                 cut = min(hi, lo + size - 1)
+                if (
+                    self.adaptive_chunks
+                    and miner.rung is not None
+                    and size == 10 ** miner.rung
+                ):
+                    # Ladder-sized chunk: cut on the next 10^k boundary so
+                    # the chunk's high digits are per-chunk constants and
+                    # the device dispatch rows are full (ops/sha256.py).
+                    # An unaligned lo yields one runt up to the boundary.
+                    cut = min(hi, ((lo // size) + 1) * size - 1)
                 if cut < hi:
                     job.pending.appendleft((cut + 1, hi))
                 # WFQ charge: carved nonces, divided by weight inside.
@@ -694,6 +890,8 @@ class Scheduler:
                 )
                 job.outstanding.setdefault(miner.conn_id, []).append((lo, cut))
                 METRICS.inc("sched.chunks_assigned")
+                if job.prefill:
+                    METRICS.inc("sched.prefill_chunks")
                 if _trace.enabled():  # hot path: attrs built only when armed
                     _trace.emit(
                         job.trace, "sched", "dispatch",

@@ -210,6 +210,15 @@ def serve(
                 METRICS.set_gauge("gauge.miners_live", st["miners"])
                 METRICS.set_gauge("gauge.inflight_chunks", st["outstanding_chunks"])
                 METRICS.set_gauge("gauge.admission_backlog", st.get("gw_queued", 0))
+                # Saturation surface (ISSUE 10): the dispatch-plane
+                # acceptance number — a straggling fleet under static
+                # chunking idles its healthy miners; adaptive sizing +
+                # tail stealing must keep this high.
+                METRICS.set_gauge(
+                    "fleet.utilization",
+                    (st["miners"] - st["idle_miners"]) / st["miners"]
+                    if st["miners"] else 0.0,
+                )
                 METRICS.set_gauge("gauge.sched_vt_floor", vt)
                 if qvt is not None:
                     METRICS.set_gauge("gauge.gw_vt_floor", qvt)
@@ -399,10 +408,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     rate: Optional[float] = 5.0
     burst = 10.0
     max_queued = 256
+    # Adaptive dispatch plane (ISSUE 10).  --chunk-target-s tunes the
+    # per-chunk service-time target the 10^k size ladder aims at;
+    # --static-chunks=N pins fixed N-nonce chunks with the ladder and the
+    # steal scan OFF (the bench comparison leg); --steal-factor tunes the
+    # fleet-p50 multiple past which a straggler's tail is re-dispatched
+    # (0 disables); --prefill=N arms N-nonce speculative gap-sweeps while
+    # idle (implies --gateway).  Env spellings for subprocess benches.
+    chunk_target_s = os.environ.get("BMT_CHUNK_TARGET_S") or None
+    static_chunks = os.environ.get("BMT_STATIC_CHUNKS") or None
+    steal_factor = os.environ.get("BMT_STEAL_FACTOR") or None
+    prefill = os.environ.get("BMT_PREFILL") or None
     pos = []
     for a in argv[1:]:
         if a.startswith("--checkpoint="):
             checkpoint_path = a.split("=", 1)[1]
+        elif a.startswith("--chunk-target-s="):
+            chunk_target_s = a.split("=", 1)[1]
+        elif a.startswith("--static-chunks="):
+            static_chunks = a.split("=", 1)[1]
+        elif a.startswith("--steal-factor="):
+            steal_factor = a.split("=", 1)[1]
+        elif a.startswith("--prefill="):
+            prefill = a.split("=", 1)[1]
         elif a.startswith("--trace="):
             trace_path = a.split("=", 1)[1]
         elif a.startswith("--telemetry-port="):
@@ -487,7 +515,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     # path; only a non-default selection threads the registry object in
     # (the contract lives in resolve_nondefault, not here).
     wl = resolve_nondefault(workload)
-    sched = Scheduler(resume_state=resume, workload=wl)
+    sched_kw: dict = {}
+    try:
+        if chunk_target_s is not None:
+            sched_kw["target_chunk_seconds"] = float(chunk_target_s)
+        if steal_factor is not None:
+            sched_kw["steal_factor"] = float(steal_factor)
+        if static_chunks is not None:
+            n = int(static_chunks)
+            sched_kw.update(
+                min_chunk=n, max_chunk=n,
+                adaptive_chunks=False, steal_factor=0.0,
+            )
+        prefill_n = int(prefill) if prefill is not None else 0
+    except ValueError as e:
+        print("Invalid scheduler configuration:", e)
+        server.close()
+        return 0
+    if prefill_n > 0:
+        # Prefill is a gateway feature: both spellings (--prefill= and
+        # BMT_PREFILL) imply --gateway, or the knob would silently no-op.
+        gateway_on = True
+    sched = Scheduler(resume_state=resume, workload=wl, **sched_kw)
     if gateway_on:
         from ..gateway import Gateway, ResultCache, SpanStore
 
@@ -498,6 +547,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             rate=rate,
             burst=burst,
             max_queued=max_queued,
+            prefill=prefill_n,
+            # Speculate only after a full second of continuous idleness:
+            # a tick landing in the gap between back-to-back requests
+            # must not hand a miner soon-to-be-orphaned work.
+            prefill_idle_s=1.0,
         )
     # Any fleet-plane knob arms the hub: the sidecar listener needs a
     # port, but the SLO engine and the publish sinks are useful even on a

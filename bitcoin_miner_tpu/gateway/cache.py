@@ -170,6 +170,13 @@ class SpanStore:
         # Same per-workload stamp contract as ResultCache (ISSUE 9).
         self.workload_name = workload or DEFAULT_WORKLOAD
         self._maps: "OrderedDict[str, IntervalMap]" = OrderedDict()
+        # Hotness (ISSUE 10): per-data count of cover() plans that found
+        # usable coverage — the speculative-prefill planner sweeps gaps
+        # adjacent to the HOTTEST keys first.  Ephemeral (not persisted):
+        # hotness is a property of the query stream, not of solved work.
+        self._hits: dict = {}
+        self._prefilled: dict = {}  # data -> nonces speculatively extended
+        self._ext_live: dict = {}  # data -> charged-but-unswept extension
         self._dirty = False
         if path is not None:
             self._load(path)
@@ -192,9 +199,19 @@ class SpanStore:
         if m is None:
             m = self._maps[data] = IntervalMap(self.max_spans_per_data)
         self._maps.move_to_end(data)  # LRU freshness
+        lost_before = m.lost_answerability
         m.add(lo, hi, hash_, nonce)
+        if m.lost_answerability > lost_before:
+            # Budget shrinking erased sub-range resolution: make the
+            # coalescing policy observable (ISSUE 10 satellite).
+            METRICS.inc(
+                "gateway.coalesce_lost", m.lost_answerability - lost_before
+            )
         while len(self._maps) > self.capacity:
-            self._maps.popitem(last=False)
+            gone, _ = self._maps.popitem(last=False)
+            self._hits.pop(gone, None)
+            self._prefilled.pop(gone, None)
+            self._ext_live.pop(gone, None)
             METRICS.inc("gateway.span_evictions")
         self._dirty = True
 
@@ -208,7 +225,78 @@ class SpanStore:
         if m is None:
             return None, ([(lo, hi)] if lo <= hi else [])
         self._maps.move_to_end(data)
-        return m.cover(lo, hi)
+        best, gaps = m.cover(lo, hi)
+        if best is not None:
+            # A plan that reused solved spans marks the key hot — the
+            # speculative-prefill planner's ranking signal (ISSUE 10).
+            self._hits[data] = self._hits.get(data, 0) + 1
+        return best, gaps
+
+    def prefill_target(
+        self, size: int, max_extend: Optional[int] = None
+    ) -> Optional[Tuple[str, int, int]]:
+        """The next speculative gap worth sweeping while the fleet idles
+        (ISSUE 10): for the hottest data keys (span-hit counters, hottest
+        first), internal gaps between solved spans come first — they are
+        what keeps overlapping queries from answering whole — then an
+        extension of ``size`` nonces past the top span, bounded per key
+        by ``max_extend`` (default ``8 × size``) so an idle fleet never
+        sweeps a key toward u64 forever.  Cold keys (no span reuse
+        observed) are never speculated on."""
+        if self.capacity == 0 or size <= 0:
+            return None
+        cap = max_extend if max_extend is not None else 8 * size
+        for data in sorted(self._hits, key=lambda d: -self._hits.get(d, 0)):
+            m = self._maps.get(data)
+            if m is None or self._hits.get(data, 0) <= 0:
+                continue
+            spans = m.spans()
+            if not spans:
+                continue
+            for i in range(len(spans) - 1):
+                g_lo, g_hi = spans[i][1] + 1, spans[i + 1][0] - 1
+                if g_lo <= g_hi:
+                    return (data, g_lo, min(g_hi, g_lo + size - 1))
+            ext = self._prefilled.get(data, 0)
+            if ext >= cap:
+                continue
+            lo = spans[-1][1] + 1
+            if lo >= 1 << 64:
+                continue
+            hi = min(lo + size - 1, (1 << 64) - 1)
+            self._prefilled[data] = ext + (hi - lo + 1)
+            self._ext_live[data] = (lo, hi)
+            return (data, lo, hi)
+        return None
+
+    def prefill_refund(self, data: str, lo: int, hi: int) -> None:
+        """Return the UNSWEPT portion of a preempted extension target to
+        the per-key budget.  :meth:`prefill_target` charges the whole
+        planned range up front (so one in-flight speculation can't be
+        re-planned past the cap); without the refund, a request cadence
+        that keeps preempting speculation before its first chunk lands
+        burns the entire extension cap without sweeping anything —
+        permanently disabling prefill for exactly the hot keys it
+        targets.  Gap targets were never charged, so only the recorded
+        live extension refunds (anything else is a no-op)."""
+        if self._ext_live.get(data) != (lo, hi):
+            return  # gap target (never charged) or stale record: no-op
+        del self._ext_live[data]
+        covered = 0
+        m = self._maps.get(data)
+        if m is not None:
+            for s in m.spans():
+                s_lo, s_hi = s[0], s[1]
+                if s_hi < lo:
+                    continue
+                if s_lo > hi:
+                    break
+                covered += min(hi, s_hi) - max(lo, s_lo) + 1
+        ext = self._prefilled.get(data, 0) - ((hi - lo + 1) - covered)
+        if ext > 0:
+            self._prefilled[data] = ext
+        else:
+            self._prefilled.pop(data, None)
 
     # ------------------------------------------------------------ persistence
 

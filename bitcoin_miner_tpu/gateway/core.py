@@ -32,6 +32,14 @@ What it adds, in the order a request meets it:
    does the underlying job get cancelled — through ``Scheduler.lost``, so
    partial progress lands in the existing checkpoint-identity orphan stash
    and a later resubmission *resumes* rather than restarts.
+2b. **Speculative span prefill** (ISSUE 10): when the fleet is fully
+   idle, the gateway feeds the scheduler low-priority synthetic
+   gap-sweeps adjacent to hot spans (``SpanStore.prefill_target``), so
+   future overlapping queries hit fully-covered even more often.  The
+   work rides a dedicated near-zero-weight WFQ tenant and is cancelled
+   outright when any real signature needs the scheduler; its chunk
+   results enter the span store exactly like real ones
+   (``gateway.prefill_jobs`` / ``gateway.prefill_preempted``).
 3. **Admission control**: at most ``max_active`` signatures run
    concurrently, and each client key has a token bucket (``rate``/
    ``burst``).  Over-limit requests queue in a weighted fair queue
@@ -99,6 +107,9 @@ class Gateway:
         max_active: int = 64,
         max_queued: int = 256,
         max_buckets: int = 4096,
+        prefill: int = 0,
+        prefill_max_per_data: Optional[int] = None,
+        prefill_idle_s: float = 0.0,
     ) -> None:
         self.sched = scheduler if scheduler is not None else Scheduler()
         self.cache = cache if cache is not None else ResultCache()
@@ -123,6 +134,21 @@ class Gateway:
         self._buckets: Dict[str, TokenBucket] = {}
         self._shed: List[int] = []
         self._next_vid = -1  # virtual ids count down; real conn ids are > 0
+        # Speculative span prefill (ISSUE 10): when the fleet is fully
+        # idle, feed the scheduler ``prefill``-nonce synthetic gap-sweeps
+        # adjacent to hot spans (SpanStore.prefill_target), charged to a
+        # dedicated near-zero-weight WFQ tenant and cancelled outright the
+        # moment any real signature needs the scheduler.  0 disables.
+        self.prefill = max(0, int(prefill))
+        self.prefill_max_per_data = prefill_max_per_data
+        # Idle dwell: the fleet must have been CONTINUOUSLY idle this long
+        # before speculating.  Sub-tick gaps between back-to-back requests
+        # are not idleness — speculating into one hands a miner a chunk
+        # the very next real request orphans (and a wedged miner then
+        # burns its next real slot sweeping dead work).
+        self.prefill_idle_s = prefill_idle_s
+        self._idle_since: Optional[float] = None
+        self._prefill_jobs: Dict[int, JobKey] = {}  # vid -> synthetic key
 
     # ------------------------------------------------------------------ events
 
@@ -162,6 +188,7 @@ class Gateway:
     def tick(self, now: float) -> List[Action]:
         out = self._translate(self.sched.tick(now), now)
         out.extend(self._admit(now))  # token buckets refill with time
+        out.extend(self._maybe_prefill(now))  # idle fleet: speculate
         return out
 
     def client_request(
@@ -333,6 +360,10 @@ class Gateway:
         """Scheduler tenant WFQ leading virtual time (gauge passthrough)."""
         return self.sched.vt_floor()
 
+    def mark_straggler(self, conn_id: int) -> None:
+        """Steal-scan passthrough (ISSUE 10): external straggler naming."""
+        self.sched.mark_straggler(conn_id)
+
     def queue_vt_floor(self) -> float:
         """Admission fair-queue leading virtual time (the serve ticker
         publishes it as ``gauge.gw_vt_floor``)."""
@@ -347,6 +378,7 @@ class Gateway:
             gw_span_waits=len(self._sub_conn),
             gw_cached=len(self.cache),
             gw_spans=len(self.spans),
+            gw_prefill=len(self._prefill_jobs),
         )
         return st
 
@@ -401,6 +433,13 @@ class Gateway:
         queued twins); if it ever did, the empty gap list makes the
         scheduler's job done at birth and the seed fans out through the
         normal path — correct either way."""
+        # A real signature needs the scheduler: speculative prefill jobs
+        # are preempted NOW, not merely outscheduled.  Every completed
+        # chunk is already a solved span (the remainder is simply dropped
+        # — never stashed or checkpointed under the synthetic key), so a
+        # later idle period re-plans the remaining gap from the span
+        # store and resumes the speculation where it stopped.
+        pre = self._cancel_prefill(now) if self._prefill_jobs else []
         data, lower, upper = key
         gaps: Optional[List[Interval]] = None
         seed: Optional[Tuple[int, int]] = None
@@ -427,7 +466,7 @@ class Gateway:
             trace, "gw", "submit",
             vid=vid, gaps=len(gaps) if gaps is not None else None,
         )
-        return self._translate(
+        return pre + self._translate(
             self.sched.client_request(
                 vid, data, lower, upper, now, tenant=client_key,
                 gaps=gaps, seed_best=seed, trace=trace,
@@ -442,6 +481,13 @@ class Gateway:
         through untouched."""
         out: List[Action] = []
         for cid, msg in actions:
+            if msg.type == MsgType.RESULT and cid in self._prefill_jobs:
+                # A speculative gap-sweep finished: no waiter to serve —
+                # its chunk spans were recorded as they completed, and the
+                # whole-range fold is a free exact-cache entry.
+                key = self._prefill_jobs.pop(cid)
+                self.cache.put(key, msg.hash, msg.nonce)
+                continue
             flight = self._by_vid.get(cid)
             if flight is None or msg.type != MsgType.RESULT:
                 out.append((cid, msg))
@@ -569,6 +615,72 @@ class Gateway:
         if answer is not None:
             METRICS.observe("hist.request_s", 0.0)
         return answer
+
+    def _maybe_prefill(self, now: float) -> List[Action]:
+        """Submit one speculative gap-sweep when the fleet is fully idle
+        (ISSUE 10): no in-flight or queued signatures, no live scheduler
+        work beyond earlier prefill, and at least one idle miner.  The
+        job runs under a dedicated WFQ tenant with near-zero weight, so
+        even before :meth:`_submit`'s outright cancellation, one carved
+        chunk charges its virtual clock so far ahead that any real tenant
+        dispatches first."""
+        if not self.prefill or not self.spans.enabled:
+            return []
+        if self._by_key or self._sub_conn or len(self._queue):
+            self._idle_since = None  # real work live: the dwell restarts
+            return []
+        if len(self._prefill_jobs) >= 1:
+            return []  # one speculation in flight at a time
+        st = self.sched.stats()
+        if st["jobs"]:  # _prefill_jobs is empty past the guard above
+            self._idle_since = None  # direct (non-gateway) work is live
+            return []
+        if st["miners"] == 0 or st["idle_miners"] == 0:
+            return []
+        # Continuous-idleness dwell (constructor comment): a sub-tick gap
+        # between back-to-back requests must not trigger speculation.
+        if self._idle_since is None:
+            self._idle_since = now
+        if now - self._idle_since < self.prefill_idle_s:
+            return []
+        target = self.spans.prefill_target(
+            self.prefill, self.prefill_max_per_data
+        )
+        if target is None:
+            return []
+        data, lower, upper = target
+        vid = self._next_vid
+        self._next_vid -= 1
+        self._prefill_jobs[vid] = (data, lower, upper)
+        METRICS.inc("gateway.prefill_jobs")
+        tid = _trace.new_id()
+        _trace.emit(
+            tid, "gw", "prefill",
+            data=data[:64], lower=lower, upper=upper, vid=vid,
+        )
+        return self._translate(
+            self.sched.client_request(
+                vid, data, lower, upper, now,
+                tenant="~prefill", weight=1e-6, prefill=True, trace=tid,
+            ),
+            now,
+        )
+
+    def _cancel_prefill(self, now: float) -> List[Action]:
+        """Preempt every speculative job (a real request arrived): through
+        ``Scheduler.lost``, so completed chunks stay solved spans; the
+        remainder is dropped (never stashed — ``lost`` skips prefill jobs)
+        and a later idle period re-plans it from the span store."""
+        out: List[Action] = []
+        for vid in list(self._prefill_jobs):
+            data, lo, hi = self._prefill_jobs.pop(vid)
+            METRICS.inc("gateway.prefill_preempted")
+            out.extend(self._translate(self.sched.lost(vid, now), now))
+            # Chunks that completed before the preemption are solved
+            # spans by now (result() drains before this); give the
+            # UNSWEPT remainder of an extension target its budget back.
+            self.spans.prefill_refund(data, lo, hi)
+        return out
 
     def _covering_flight(
         self, data: str, lower: int, upper: int, key: JobKey

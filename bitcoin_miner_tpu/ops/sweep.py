@@ -24,6 +24,7 @@ Decomposition invariants:
 from __future__ import annotations
 
 import collections
+import os as _os
 import time as _time
 from dataclasses import dataclass
 from functools import lru_cache
@@ -469,6 +470,18 @@ def _invoke_kernel(backend, kern, midstate, tail_const, bounds):
     )
 
 
+#: TPU-runtime fault injection (ISSUE 10 satellite, carry-over from PR 2):
+#: ``BMT_WEDGE_DISPATCH=N`` makes the N-th result fetched by the FIRST
+#: armed pipeline in this process hang until that pipeline is closed —
+#: exactly what a wedged device future looks like from the outside — so
+#: the miner watchdog's tier-downgrade drill exercises a real stuck
+#: dispatch inside :class:`SweepPipeline` instead of only a simulated
+#: sleeping search fn.  One-shot per process: the fallback tier the
+#: watchdog builds next must not inherit the wedge and cascade off the
+#: bottom of the chain.
+_WEDGE_STATE = {"fired": False}
+
+
 class SweepPipeline:
     """Cross-request sweep pipeline: the device never idles between jobs.
 
@@ -547,6 +560,14 @@ class SweepPipeline:
             self._rolled = not is_tpu_device(mesh.devices.flat[0])
         else:
             self._rolled = not is_tpu()
+        # Fault injection (module constant above): which fetched result,
+        # if any, this pipeline should wedge on.  Read once at build so a
+        # late env mutation can't arm a production pipeline mid-run.
+        try:
+            self._wedge_after = int(_os.environ.get("BMT_WEDGE_DISPATCH", "0") or 0)
+        except ValueError:
+            self._wedge_after = 0
+        self._fetched_count = 0
         self._prewarmed: set = set()
         self._prewarm_lock = threading.Lock()
         # Single-flight warm-up per kernel class (keyed by the lru-cached
@@ -760,6 +781,20 @@ class SweepPipeline:
                 return
             state, out, bases, n_lanes = item
             fut = state["fut"]
+            if (
+                self._wedge_after
+                and out is not self._DONE
+                and not _WEDGE_STATE["fired"]
+            ):
+                self._fetched_count += 1
+                if self._fetched_count >= self._wedge_after:
+                    # Injected wedge: this fetch never completes (the
+                    # future hangs exactly like a stuck device runtime)
+                    # until close() — the watchdog's budget must fire.
+                    _WEDGE_STATE["fired"] = True
+                    while not self._closed:
+                        _time.sleep(0.02)
+                    continue  # closing: drop the fetch, future stays open
             if out is self._DONE:
                 if not fut.done():  # not already failed by the dispatcher
                     best = state["best"]

@@ -84,10 +84,17 @@ class IntervalMap:
     - :meth:`add` keeps spans disjoint: overlapping inserts merge (their
       union is covered by the inputs, so folding minima is exact);
       *adjacent* spans stay separate to preserve sub-range resolution.
-    - Over ``max_spans``, :meth:`_shrink` first coalesces the narrowest
-      adjacent pair (lossless for "is it swept", lossy only for
-      resolution) and only with no adjacency left forgets the narrowest
-      span (cheapest to re-sweep).
+    - Over ``max_spans``, :meth:`_shrink` coalesces an adjacent pair
+      (lossless for "is it swept", lossy only for resolution), preferring
+      the merge that erases the least answerability: the merged span
+      keeps the smaller fold, so the OTHER side's argmin stops being
+      usable evidence for sub-queries that exclude the winner —
+      argmin-placement-aware cost = the losing side's width, tie-broken
+      to the narrowest combined span (the old rule).  Only with no
+      adjacency left is the narrowest span forgotten (cheapest to
+      re-sweep).  Cumulative nonces whose sub-range resolution was lost
+      accrue in :attr:`lost_answerability` so the policy is observable
+      (``gateway.coalesce_lost``).
     - :meth:`cover` is the planner: fold of answerable portions + the
       gap list a remainder sweep must still cover.
 
@@ -97,6 +104,9 @@ class IntervalMap:
     def __init__(self, max_spans: int = 64) -> None:
         self.max_spans = max(1, int(max_spans))
         self._spans: List[Span] = []  # disjoint, sorted by lo
+        #: Cumulative nonces whose span-level answerability was lost to
+        #: budget shrinking (merged-away argmins + dropped spans).
+        self.lost_answerability = 0
 
     def __len__(self) -> int:
         return len(self._spans)
@@ -161,23 +171,32 @@ class IntervalMap:
 
     def _shrink(self) -> None:
         while len(self._spans) > self.max_spans:
-            narrow_i = -1
-            narrow_size: Optional[int] = None
+            best_i = -1
+            best_cost: Optional[Tuple[int, int]] = None
             for i in range(len(self._spans) - 1):
                 a, b = self._spans[i], self._spans[i + 1]
                 if a[1] + 1 == b[0]:
-                    size = b[1] - a[0] + 1
-                    if narrow_size is None or size < narrow_size:
-                        narrow_i, narrow_size = i, size
-            if narrow_i >= 0:
-                a, b = self._spans[narrow_i], self._spans[narrow_i + 1]
+                    # The merged span keeps min(a.fold, b.fold); the side
+                    # whose argmin loses can no longer answer sub-queries
+                    # alone — its width is the answerability cost.
+                    loser = b if (a[2], a[3]) <= (b[2], b[3]) else a
+                    cost = (loser[1] - loser[0] + 1, b[1] - a[0] + 1)
+                    if best_cost is None or cost < best_cost:
+                        best_i, best_cost = i, cost
+            if best_i >= 0:
+                a, b = self._spans[best_i], self._spans[best_i + 1]
                 fold = min((a[2], a[3]), (b[2], b[3]))
-                self._spans[narrow_i : narrow_i + 2] = [
+                self._spans[best_i : best_i + 2] = [
                     (a[0], b[1], fold[0], fold[1])
                 ]
+                assert best_cost is not None
+                self.lost_answerability += best_cost[0]
             else:
                 drop = min(
                     range(len(self._spans)),
                     key=lambda i: self._spans[i][1] - self._spans[i][0],
+                )
+                self.lost_answerability += (
+                    self._spans[drop][1] - self._spans[drop][0] + 1
                 )
                 del self._spans[drop]

@@ -310,6 +310,9 @@ def format_quantiles(h) -> str:
 #:   sched.jobs_resumed        jobs resumed from a checkpoint
 #:   sched.jobs_orphaned       dead clients' progress stashed for resubmit
 #:   sched.nonces_swept        nonces in accepted chunk Results (rate source)
+#:   sched.chunk_size_adapt    miner chunk-size rung moves on the 10^k ladder
+#:   sched.steals              straggler chunk tails re-dispatched to idle miners
+#:   sched.prefill_chunks      chunks dispatched for speculative prefill jobs
 #:   gateway.requests          client Requests that reached the gateway
 #:   gateway.cache_hits        answered from the content-addressed cache
 #:   gateway.cache_evictions   cache entries dropped by the LRU bound
@@ -324,6 +327,9 @@ def format_quantiles(h) -> str:
 #:   gateway.nonces_saved      nonces answered from spans instead of swept
 #:   gateway.span_evictions    span-store data keys dropped by the LRU bound
 #:   gateway.inflight_span_waits  sub-range requests parked on a covering running sweep
+#:   gateway.prefill_jobs      speculative gap-sweep jobs submitted while idle
+#:   gateway.prefill_preempted prefill jobs cancelled by an arriving real request
+#:   gateway.coalesce_lost     nonces whose sub-range answerability span coalescing erased
 #:   federation.forwarded      requests routed to their home replica's federation port
 #:   federation.local_answers  non-home requests answered from local cache/gossiped spans
 #:   federation.forward_failovers  forward attempts re-routed past a dead replica
@@ -365,6 +371,7 @@ def format_quantiles(h) -> str:
 #:   fleet.sources             fresh telemetry sources in the fleet view
 #:   fleet.sources_stale       sources aged past the staleness window
 #:   fleet.stragglers          sources flagged by the straggler detector
+#:   fleet.utilization         fraction of live miners currently holding work
 METRICS = Metrics()
 
 

@@ -372,6 +372,111 @@ def test_watchdog_miner_serves_fleet_after_downgrade():
         server.close()
 
 
+def test_wedge_dispatch_hook_downgrades_real_wedged_pipeline(monkeypatch):
+    """ISSUE 10 satellite (carry-over from PR 2): ``BMT_WEDGE_DISPATCH=1``
+    hangs the first result fetched by a REAL :class:`SweepPipeline` — a
+    genuine stuck device future inside the dispatch/fetch machinery, not
+    a simulated sleeping search fn — and the watchdog's budget must abandon
+    that tier, close the wedged pipeline (which releases the injected
+    hang) and complete the chunk on the next rung without cascading."""
+    from bitcoin_miner_tpu.ops import sweep as sweep_mod
+
+    monkeypatch.setenv("BMT_WEDGE_DISPATCH", "1")
+    monkeypatch.setitem(sweep_mod._WEDGE_STATE, "fired", False)
+    downgrades0 = METRICS.get("miner.tier_downgrades")
+    ts = miner_mod._TieredSearch(
+        [
+            ("xla-pipe", lambda: miner_mod._PipelineSearch("xla")),
+            ("oracle", lambda: min_hash_range),
+        ],
+        wedge_seconds=4.0,
+    )
+    try:
+        fut = ts.submit("wedgedisp", 0, 80)
+        assert fut.result(timeout=120) == min_hash_range("wedgedisp", 0, 80)
+        assert ts.active_tier == "oracle"
+        assert METRICS.get("miner.tier_downgrades") - downgrades0 == 1
+        assert sweep_mod._WEDGE_STATE["fired"]  # the hang was real
+        # One-shot per process: a later chunk on the downgraded chain (or
+        # any future pipeline) must not inherit the wedge.
+        assert ts.submit("wedgedisp2", 0, 50).result(timeout=30) == (
+            min_hash_range("wedgedisp2", 0, 50)
+        )
+    finally:
+        ts.close()
+
+
+@pytest.mark.analysis
+def test_straggler_tail_steal_soak_whole_range_correct_sanitized():
+    """ISSUE 10 chaos-soak leg: a seeded burst-lossy fleet whose slowest
+    miner wedges flat on its first chunk (live-but-hung).  The steal scan
+    re-dispatches the hostage chunk's tail to an idle healthy miner well
+    before the full straggler re-queue fires, the job completes
+    whole-range-correct against the hashlib oracle, and the whole weave
+    runs green under the BMT_SANITIZE=1 race machinery."""
+    from bitcoin_miner_tpu.utils import sanitize
+
+    sanitize.force(True)
+    sanitize.reset_order_graph()
+    CHAOS.reset()
+    CHAOS.seed(31)
+    CHAOS.run(standard_scenarios()["burst-loss"], loop_every=2.0)
+    steals0 = METRICS.get("sched.steals")
+    server = lsp.Server(0, PARAMS, label="server")
+    sched = Scheduler(
+        min_chunk=500, max_chunk=2000,
+        straggler_min_seconds=2.5,
+        steal_min_seconds=0.3, steal_min_samples=4,
+    )
+    lock = sanitize.make_lock("steal-soak")
+    threading.Thread(
+        target=server_mod.serve, args=(server, sched),
+        kwargs={"tick_interval": 0.1, "lock": lock}, daemon=True,
+    ).start()
+
+    wedged_once = threading.Event()
+
+    def slow_search(d, lo, hi):
+        # First chunk wedges flat for 8 s (a stuck-runtime episode, the
+        # regime the steal scan exists for); honest afterwards.
+        if not wedged_once.is_set():
+            wedged_once.set()
+            time.sleep(8.0)
+        return min_hash_range(d, lo, hi)
+
+    searches = [slow_search, min_hash_range, min_hash_range]
+    for i, fn in enumerate(searches):
+        mc = lsp.Client("127.0.0.1", server.port, PARAMS, label=f"m{i}")
+        threading.Thread(
+            target=miner_mod.run_miner, args=(mc, fn), daemon=True
+        ).start()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with lock:
+                n = sched.stats()["miners"]
+            if n == len(searches):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("miners never joined")
+        c = lsp.Client("127.0.0.1", server.port, PARAMS, label="client-0")
+        try:
+            got = client_mod.request_once(c, "stealsoak", 20_000)
+        finally:
+            c.close()
+        assert got == min_hash_range("stealsoak", 0, 20_000)
+        # The induced straggler's tail really was stolen (not merely
+        # ridden out by the full re-queue).
+        assert METRICS.get("sched.steals") > steals0
+        assert wedged_once.is_set()
+    finally:
+        CHAOS.reset()
+        server.close()
+        sanitize.force(None)
+        sanitize.reset_order_graph()
+
+
 def test_client_resubmit_resumes_from_orphan_stash():
     """Kill a client mid-job; the scheduler stashes the job's progress
     under its (data, lower, upper) identity, and the resubmitted identical

@@ -669,6 +669,159 @@ class TestInflightSpanWait:
         assert g.miner_joined(20, now=0.3) == []  # role confusion refused
 
 
+class TestSpeculativePrefill:
+    """Speculative span prefill (ISSUE 10): an idle fleet sweeps gaps and
+    extensions adjacent to HOT spans under a near-zero-weight tenant, so
+    future overlapping queries answer fully-covered; any real request
+    preempts the speculation outright."""
+
+    def _hot_solved_gateway(self, prefill=100):
+        """[0,299] solved as three 100-nonce chunks, then a covered
+        sub-range query marks DATA hot; fleet idle afterwards."""
+        g = make_gateway(
+            sched={"min_chunk": 100, "max_chunk": 100,
+                   "validate_results": False},
+            prefill=prefill,
+        )
+        g.miner_joined(1, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        g.result(1, hash_=700, nonce=50, now=1.0)
+        g.result(1, hash_=600, nonce=150, now=2.0)
+        g.result(1, hash_=650, nonce=210, now=3.0)
+        acts = g.client_request(20, DATA, 50, 249, now=4.0)  # span hit: hot
+        assert results(acts)
+        return g
+
+    def test_idle_fleet_prefills_extension_of_hot_span(self):
+        METRICS.reset()
+        g = self._hot_solved_gateway()
+        acts = g.tick(5.0)
+        req = requests(acts)
+        # The speculative sweep extends past the hot span's top.
+        assert [(m.lower, m.upper) for _, m in req] == [(300, 399)]
+        assert req[0][0] == 1  # dispatched to the idle miner
+        assert METRICS.get("gateway.prefill_jobs") == 1
+        assert METRICS.get("sched.prefill_chunks") == 1
+        # One speculation in flight at a time.
+        assert g.tick(5.1) == []
+
+    def test_prefill_result_enters_spans_and_overlap_answers_zero_chunks(self):
+        METRICS.reset()
+        g = self._hot_solved_gateway()
+        g.tick(5.0)
+        # The idle miner completes the speculative chunk: no waiter to
+        # serve, the fold lands in the span store AND the exact cache.
+        acts = g.result(1, hash_=800, nonce=300, now=6.0)
+        assert results(acts) == []  # no client ever asked for it
+        assigned = METRICS.get("sched.chunks_assigned")
+        # An overlapping query past the originally requested range: fully
+        # covered by real spans + the speculative extension.
+        acts = g.client_request(30, DATA, 50, 349, now=7.0)
+        assert results(acts) == [(30, acts[-1][1])]
+        assert (acts[-1][1].hash, acts[-1][1].nonce) == (600, 150)
+        assert METRICS.get("sched.chunks_assigned") == assigned
+
+    def test_real_request_preempts_prefill_outright(self):
+        METRICS.reset()
+        g = self._hot_solved_gateway()
+        g.tick(5.0)  # speculation dispatched to the lone miner
+        # A real request for OTHER data: the prefill job is cancelled NOW
+        # (not merely outscheduled) and the real sweep gets the miner.
+        acts = g.client_request(40, "other-data", 0, 99, now=5.5)
+        req = requests(acts)
+        assert [m.data for _, m in req] == ["other-data"]
+        assert METRICS.get("gateway.prefill_preempted") == 1
+        assert g.sched.stats()["jobs"] == 1  # only the real job remains
+        # The preempted chunk's eventual Result is stale (its synthetic
+        # client is lost): ignored, miner back to work.
+        assert results(g.result(1, hash_=800, nonce=300, now=6.0)) == []
+
+    def test_prefill_respects_queued_and_inflight_work(self):
+        METRICS.reset()
+        g = self._hot_solved_gateway()
+        g.client_request(50, DATA, 0, 999, now=5.0)  # real work in flight
+        assert g.tick(5.1) == []  # busy fleet: no speculation
+        assert METRICS.get("gateway.prefill_jobs") == 0
+
+    def test_prefill_disabled_by_default(self):
+        METRICS.reset()
+        g = self._hot_solved_gateway(prefill=0)
+        assert g.tick(5.0) == []
+        assert METRICS.get("gateway.prefill_jobs") == 0
+
+    def test_prefill_idle_dwell_gates_speculation(self):
+        """A sub-dwell gap between requests is not idleness: speculation
+        waits for prefill_idle_s of CONTINUOUS idle, and real work
+        restarts the clock."""
+        METRICS.reset()
+        g = make_gateway(
+            sched={"min_chunk": 100, "max_chunk": 100,
+                   "validate_results": False},
+            prefill=100, prefill_idle_s=2.0,
+        )
+        g.miner_joined(1, now=0.0)
+        g.client_request(10, DATA, 0, 299, now=0.0)
+        g.result(1, hash_=700, nonce=50, now=1.0)
+        g.result(1, hash_=600, nonce=150, now=2.0)
+        g.result(1, hash_=650, nonce=210, now=3.0)
+        g.client_request(20, DATA, 50, 249, now=4.0)  # hot
+        assert g.tick(5.0) == []  # dwell clock starts here
+        assert g.tick(6.0) == []  # 1.0 s idle < 2.0 s dwell
+        # Real work mid-dwell restarts the clock entirely.
+        g.client_request(30, DATA, 0, 399, now=6.5)
+        g.tick(6.6)
+        g.result(1, hash_=640, nonce=350, now=7.0)  # [300,399] sweep done
+        assert g.tick(8.0) == []  # first idle tick: dwell restarts here
+        assert g.tick(9.9) == []  # 1.9 s idle < 2.0 s dwell
+        acts = g.tick(10.1)  # 2.1 s of continuous idle: speculate
+        assert requests(acts)
+        assert METRICS.get("gateway.prefill_jobs") == 1
+
+    def test_preempted_extension_refunds_unswept_budget(self):
+        """prefill_target charges the whole planned extension up front;
+        a preemption before any chunk lands must refund it, or a request
+        cadence that keeps interrupting speculation burns the per-key cap
+        (default 8×size) without sweeping a nonce and permanently
+        disables prefill for exactly the hot keys it targets."""
+        METRICS.reset()
+        g = self._hot_solved_gateway()
+        now = 5.0
+        for i in range(10):  # 10 cycles > the 8-cycle cap
+            acts = g.tick(now)
+            req = requests(acts)
+            # Nothing ever completes, so the planned extension never moves.
+            assert [(m.lower, m.upper) for _, m in req] == [(300, 399)], i
+            # Real request preempts before the speculative chunk lands.
+            acts = g.client_request(40 + i, f"other-{i}", 0, 99, now + 0.1)
+            assert requests(acts)
+            now += 1.0
+            assert results(g.result(1, hash_=800, nonce=300, now=now)) == []
+            acts = g.result(1, hash_=900 + i, nonce=9, now=now + 0.1)
+            assert [cid for cid, _ in results(acts)] == [40 + i]
+            now += 1.0
+        assert METRICS.get("gateway.prefill_jobs") == 10
+        assert METRICS.get("gateway.prefill_preempted") == 10
+
+    def test_prefill_never_enters_resume_stash_or_checkpoint(self):
+        """Speculative jobs must not stash under their synthetic keys:
+        the bounded orphan FIFO (and the checkpoint built from it) would
+        evict REAL dead clients' resume progress for work that is already
+        persisted as solved spans."""
+        METRICS.reset()
+        g = self._hot_solved_gateway()
+        g.tick(5.0)  # speculation over (DATA, 300, 399) in flight
+
+        def keys(ck):
+            return {(j["data"], j["lower"], j["upper"]) for j in ck["jobs"]}
+
+        assert keys(g.sched.checkpoint()) == set()  # live prefill skipped
+        orphaned = METRICS.get("sched.jobs_orphaned")
+        g.client_request(40, "other-data", 0, 99, now=5.5)  # preempt
+        assert METRICS.get("sched.jobs_orphaned") == orphaned
+        # Only the live REAL job's key may appear in the snapshot.
+        assert keys(g.sched.checkpoint()) == {("other-data", 0, 99)}
+
+
 class TestAdmission:
     def test_max_active_queues_then_admits_on_completion(self):
         g = make_gateway(max_active=1)

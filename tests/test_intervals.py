@@ -128,6 +128,69 @@ def test_budget_prefers_adjacent_coalesce_then_drops_narrowest():
     assert (0, 19, 600, 15) in m.spans()
 
 
+def test_coalesce_prefers_merge_that_keeps_answerability():
+    """ISSUE 10 satellite: the merged span keeps the smaller fold, so the
+    LOSING side's width is the sub-range answerability erased.  Given a
+    narrow loser in a WIDE pair and a wide loser in a NARROW pair, the
+    argmin-placement-aware policy picks the narrow loser — the old
+    narrowest-combined-first rule would have picked the other."""
+    m = IntervalMap(max_spans=3)
+    m.add(0, 149, 1, 0)      # wide winner...
+    m.add(150, 154, 9, 152)  # ...adjacent narrow loser: cost 5, combined 155
+    m.add(200, 249, 7, 210)  # wide loser...
+    m.add(250, 259, 2, 255)  # ...adjacent narrow winner: cost 50, combined 60
+    # Narrowest-combined would merge [200,259] (60 < 155) and erase the
+    # 50-nonce [200,249]'s argmin; the answerability-aware rule merges
+    # [0,154] and erases only 5 nonces.
+    assert m.spans() == [
+        (0, 154, 1, 0), (200, 249, 7, 210), (250, 259, 2, 255)
+    ]
+    assert m.lost_answerability == 5
+    # The preserved wide span still answers its own sub-queries.
+    assert m.cover(200, 249) == ((7, 210), [])
+
+
+def test_lost_answerability_accrues_on_drop_too():
+    m = IntervalMap(max_spans=1)
+    m.add(0, 99, 5, 50)
+    m.add(200, 219, 7, 210)  # no adjacency: narrowest span is forgotten
+    assert m.spans() == [(0, 99, 5, 50)]
+    assert m.lost_answerability == 20
+
+
+def test_spanstore_counts_coalesce_lost_metric():
+    from bitcoin_miner_tpu.utils.metrics import METRICS
+
+    METRICS.reset()
+    s = SpanStore(max_spans_per_data=2)
+    s.add("a", 0, 99, 1, 0)
+    s.add("a", 100, 109, 9, 105)
+    s.add("a", 200, 299, 3, 250)  # over budget: [0,99]+[100,109] merge
+    assert METRICS.get("gateway.coalesce_lost") == 10
+    assert s.cover("a", 0, 109) == ((1, 0), [])
+
+
+def test_spanstore_prefill_targets_hot_gaps_then_bounded_extension():
+    """ISSUE 10: speculative targets come from HOT keys only (span-hit
+    counters), internal gaps before extensions, extensions bounded per
+    key so an idle fleet never sweeps toward u64 forever."""
+    s = SpanStore()
+    s.add("cold", 0, 99, 5, 50)
+    s.add("hot", 0, 99, 1, 10)
+    s.add("hot", 200, 299, 2, 250)
+    assert s.prefill_target(100) is None  # nothing hot yet
+    assert s.cover("hot", 0, 99) == ((1, 10), [])  # span reuse: hot now
+    # The internal gap [100,199] comes first, clipped to the ask size.
+    assert s.prefill_target(50) == ("hot", 100, 149)
+    s.add("hot", 100, 199, 4, 120)  # gap swept (speculatively)
+    # Then extensions past the top span, bounded at 2 x 50 nonces.
+    assert s.prefill_target(50, max_extend=100) == ("hot", 300, 349)
+    s.add("hot", 300, 349, 6, 320)
+    assert s.prefill_target(50, max_extend=100) == ("hot", 350, 399)
+    s.add("hot", 350, 399, 7, 360)
+    assert s.prefill_target(50, max_extend=100) is None  # budget spent
+
+
 @pytest.mark.parametrize("seed", range(6))
 def test_property_cover_plus_remainder_equals_full_sweep(seed):
     """Random span layouts over REAL minima: for any query, span-fold +

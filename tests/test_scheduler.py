@@ -7,6 +7,7 @@ cancellation, fairness.
 """
 
 from bitcoin_miner_tpu.apps.scheduler import Scheduler
+from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
 from bitcoin_miner_tpu.bitcoin.message import MsgType
 
 
@@ -198,7 +199,8 @@ class TestPipelining:
     def test_ramp_boost_grows_chunks_geometrically(self):
         # A fast miner completing min_chunk in a blink gets ramp_factor x
         # its last chunk, not just rate*target (which the per-chunk latency
-        # in the EWMA understates during ramp).
+        # in the EWMA understates during ramp) — snapped to the nearest
+        # 10^k rung of the aligned size ladder (8000 -> 10^4, ISSUE 10).
         s = Scheduler(
             validate_results=False,
             min_chunk=1000,
@@ -210,10 +212,27 @@ class TestPipelining:
         s.miner_joined(1, now=0.0)
         s.client_request(10, "d", 0, 10**9, now=0.0)
         # 1000 nonces in 0.2s -> EWMA rate 5000/s -> rate-based next chunk
-        # would be 2500; the boost gives 8x1000 = 8000.
+        # would be 2500; the boost gives 8x1000 = 8000 -> rung 10^4.  The
+        # carve cuts on the rung boundary, so lower=1000 runs to 9999 (a
+        # runt up to the boundary); the NEXT chunk is a full aligned rung.
         actions = s.result(1, hash_=5, nonce=7, now=0.2)
         nxt = actions[0][1]
-        assert nxt.upper - nxt.lower + 1 == 8000
+        assert (nxt.lower, nxt.upper) == (1000, 9999)
+        # Still fast -> the ramp keeps climbing the ladder: next chunk is
+        # a full aligned rung (10^5 here: 8x the 9000-nonce runt, snapped).
+        actions = s.result(1, hash_=5, nonce=nxt.lower, now=0.4)
+        nxt = actions[0][1]
+        assert (nxt.lower, nxt.upper) == (10_000, 99_999)
+        # Legacy (ladder off) keeps the raw boosted size.
+        s2 = Scheduler(
+            validate_results=False, min_chunk=1000,
+            target_chunk_seconds=0.5, rate_alpha=1.0,
+            pipeline_depth=1, ramp_factor=8, adaptive_chunks=False,
+        )
+        s2.miner_joined(1, now=0.0)
+        s2.client_request(10, "d", 0, 10**9, now=0.0)
+        actions = s2.result(1, hash_=5, nonce=7, now=0.2)
+        assert actions[0][1].upper - actions[0][1].lower + 1 == 8000
 
 
 class TestAdaptiveChunking:
@@ -236,7 +255,175 @@ class TestAdaptiveChunking:
         s.client_request(10, "d", 0, 10**9, now=0.0)
         actions = s.result(1, hash_=7, nonce=0, now=1e-9)  # absurd rate
         nxt = actions[0][1]
-        assert nxt.upper - nxt.lower + 1 == 1000
+        # Capped at max_chunk (the 10^3 rung) and cut on the rung
+        # boundary: lower=20 (after the two cold chunks) runs to 999.
+        assert nxt.upper - nxt.lower + 1 <= 1000
+        assert (nxt.upper + 1) % 1000 == 0
+
+
+class TestStealScan:
+    """Straggler tail re-dispatch (ISSUE 10): a slow chunk's tail is
+    handed to an idle miner, first completed sub-interval wins, and the
+    interval-subtraction bookkeeping keeps every completion order
+    bit-exact against a from-scratch sweep."""
+
+    def _one_chunk_fleet(self, **kw):
+        # Whole range in ONE chunk at miner 1; miner 2 idle.
+        kw.setdefault("validate_results", False)
+        kw.setdefault("min_chunk", 10**6)
+        s = Scheduler(**kw)
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "d", 0, 999, now=0.0)
+        s.miner_joined(2, now=0.0)
+        return s
+
+    def test_marked_straggler_tail_stolen_to_idle_miner(self):
+        s = self._one_chunk_fleet()
+        s.mark_straggler(1)  # the PR-7 fleet detector's external naming
+        acts = s.tick(now=0.1)  # no age evidence needed: mark suffices
+        assert len(acts) == 1
+        cid, msg = acts[0]
+        assert cid == 2 and msg.type == MsgType.REQUEST
+        # The upper half: the straggler sweeps low nonces first.
+        assert (msg.lower, msg.upper) == (500, 999)
+        # The holder still owes the WHOLE interval; the tail is recorded
+        # as its duplicated portion.
+        assert s.miners[1].queue[0].stolen == (500, 999)
+
+    def test_age_based_steal_needs_fleet_p50_evidence(self):
+        s = Scheduler(
+            validate_results=False, min_chunk=100, max_chunk=100,
+            pipeline_depth=1, steal_min_seconds=0.0, steal_min_samples=4,
+        )
+        s.miner_joined(1, now=0.0)
+        # Exactly 5 chunks: after 4 completions the LAST chunk is the
+        # front and the job has no pending work left for a joiner.
+        s.client_request(10, "d", 0, 499, now=0.0)
+        # Build fleet evidence: 4 accepted chunks at ~0.1 s each.
+        for i in range(4):
+            s.result(1, hash_=5, nonce=100 * i, now=0.1 * (i + 1))
+        s.miner_joined(2, now=0.45)  # idle thief, nothing to dispatch
+        # Miner 1's running chunk started at 0.4; at 0.5 it is younger
+        # than steal_factor(2.0) x p50(0.1) -> no steal yet.
+        assert s.tick(now=0.5) == []
+        acts = s.tick(now=0.7)  # age 0.3 > 0.2: tail re-dispatched
+        assert [m.type for _, m in acts] == [MsgType.REQUEST]
+        assert acts[0][0] == 2
+
+    def test_cold_fleet_never_steals_on_guesses(self):
+        s = self._one_chunk_fleet(steal_min_seconds=0.0)
+        # No chunk has EVER completed: no p50, no steal however old (5 s
+        # stays under the full straggler re-queue's 10 s floor).
+        assert s.tick(now=5.0) == []
+
+    def test_steal_flagged_miner_gets_no_new_work(self):
+        s = self._one_chunk_fleet()
+        s.mark_straggler(1)
+        s.tick(now=0.1)
+        # A second job: every chunk must route around the flagged holder.
+        acts = s.client_request(11, "e", 0, 999, now=0.2)
+        assert {cid for cid, _ in acts} == {2}
+
+    def test_stolen_front_never_restolen(self):
+        s = self._one_chunk_fleet()
+        s.mark_straggler(1)
+        s.tick(now=0.1)
+        s.miner_joined(3, now=0.2)  # another idle miner appears
+        s.mark_straggler(1)
+        assert s.tick(now=0.3) == []  # escalation is the full re-queue
+
+    def test_valid_answer_clears_stale_straggler_mark(self):
+        """A mark that found no idle thief must die when the miner
+        answers: stale fleet-detector evidence cannot steal from a
+        fresh, healthy chunk minutes later."""
+        s = Scheduler(validate_results=False, min_chunk=10**6)
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "d", 0, 999, now=0.0)
+        s.mark_straggler(1)  # no idle miner exists: mark cannot act
+        assert s.tick(now=0.1) == []
+        s.result(1, hash_=5, nonce=7, now=0.2)  # the miner ANSWERS
+        s.client_request(11, "e", 0, 999, now=0.3)  # fresh chunk, miner 1
+        s.miner_joined(2, now=0.4)  # an idle thief appears later
+        # The fresh front chunk is not stolen on the stale mark (and is
+        # far too young for age evidence).
+        assert s.tick(now=0.5) == []
+        s = Scheduler(validate_results=False, min_chunk=10**6)
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "d", 0, 999, now=0.0, prefill=True)
+        s.miner_joined(2, now=0.0)
+        s.mark_straggler(1)
+        assert s.tick(now=0.1) == []  # speculation isn't worth duplicating
+
+    def test_split_on_steal_bit_exact_property(self):
+        """The ISSUE 10 property: random split points over real hashlib
+        minima — whichever sub-interval completes first, the winner's
+        fold plus the discarded loser's overlap equals a from-scratch
+        sweep, with oracle validation ON."""
+        import random
+
+        rng = random.Random(0xBEEF)
+        for trial in range(6):
+            lo = rng.randrange(0, 800)
+            hi = lo + rng.randrange(40, 400)
+            data = f"steal-{trial}"
+            order = trial % 3
+            s = Scheduler(min_chunk=10**6, pipeline_depth=1)
+            s.miner_joined(1, now=0.0)
+            s.client_request(10, data, lo, hi, now=0.0)
+            s.miner_joined(2, now=0.0)
+            s.mark_straggler(1)
+            acts = s.tick(now=0.5)
+            (thief, tail_msg), = acts
+            t_lo, t_hi = tail_msg.lower, tail_msg.upper
+            assert thief == 2 and lo < t_lo <= t_hi == hi
+            done = []
+            if order == 0:
+                # Thief first, then the straggler's full interval: the
+                # losing duplicate folds harmlessly (min over a superset).
+                done += s.result(2, *min_hash_range(data, t_lo, t_hi), now=1.0)
+                done += s.result(1, *min_hash_range(data, lo, hi), now=2.0)
+            elif order == 1:
+                # Straggler's full interval first: it wins outright, the
+                # thief's in-flight duplicate is withdrawn/ignored.
+                done += s.result(1, *min_hash_range(data, lo, hi), now=1.0)
+                done += s.result(2, *min_hash_range(data, t_lo, t_hi), now=2.0)
+            else:
+                # Straggler never answers: the full straggler re-queue
+                # escalates (head only — the tail copy is already live),
+                # and the thief sweeps both halves.
+                s.tick(now=100.0)  # past straggler_min_seconds
+                acts = s.result(2, *min_hash_range(data, t_lo, t_hi), now=101.0)
+                heads = [
+                    (m.lower, m.upper) for cid, m in acts
+                    if cid == 2 and m.type == MsgType.REQUEST
+                ]
+                assert heads == [(lo, t_lo - 1)]
+                done += acts
+                done += s.result(2, *min_hash_range(data, lo, t_lo - 1), now=102.0)
+            final = [(cid, m) for cid, m in done if m.type == MsgType.RESULT]
+            assert len(final) == 1 and final[0][0] == 10
+            want = min_hash_range(data, lo, hi)
+            assert (final[0][1].hash, final[0][1].nonce) == want
+
+    def test_late_straggler_result_withdraws_tail_duplicate(self):
+        """Thief still computing when the straggler answers after all:
+        the tail's PENDING portion is withdrawn so it never re-dispatches,
+        and the job completes on the straggler's fold alone."""
+        s = Scheduler(validate_results=False, min_chunk=10**6)
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "d", 0, 999, now=0.0)
+        s.mark_straggler(1)
+        assert s.tick(now=0.1) == []  # no idle miner: tail stays pending?
+        # No steal happened (no idle miner); now one appears and the
+        # steal lands, but the thief dies before answering.
+        s.miner_joined(2, now=0.2)
+        s.mark_straggler(1)
+        s.tick(now=0.3)
+        s.lost(2, now=0.4)  # thief dies: tail back to pending
+        done = s.result(1, hash_=5, nonce=3, now=0.5)
+        final = [(cid, m) for cid, m in done if m.type == MsgType.RESULT]
+        assert len(final) == 1 and final[0][0] == 10
+        assert s.jobs == {}  # nothing pending: duplicate fully withdrawn
 
 
 class TestFairness:

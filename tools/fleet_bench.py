@@ -557,6 +557,333 @@ def run_federation_bench(args) -> int:
             c.kill()
 
 
+def run_dispatch_compare(args) -> int:
+    """The adaptive-dispatch leg (ISSUE 10): static fixed-size chunking vs
+    the 10^k ladder + straggler-tail stealing (+ speculative span prefill)
+    on the SAME seeded chaos weather and the same induced straggler.
+
+    An in-process loopback fleet (the chaos-drill substrate): one serve
+    loop over a Gateway, ``--dc-miners`` hashlib miner threads of which
+    miner-0 is the induced straggler — it computes at ``--dc-slow-rate``
+    nonces/s and flat-out wedges every third chunk for ``--dc-wedge-s``
+    seconds (the live-but-hung regime the steal scan exists for).  Each
+    leg runs the same job batch through 2 client workers, sampling
+    ``fleet.utilization`` (busy/live miners) under the event lock; every
+    Result is validated against the hashlib oracle.  The adaptive leg
+    then runs the zero-chunk probes: an exact repeat (cache), a solved
+    sub-range (spans), and — after the idle fleet speculatively extends
+    the hot key — an overlapping query past the originally requested
+    range (prefill).  Prints one JSON line (the BENCH_pr10 artifact)."""
+    import threading
+
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.apps import client as client_mod
+    from bitcoin_miner_tpu.apps import miner as miner_mod
+    from bitcoin_miner_tpu.apps import server as server_mod
+    from bitcoin_miner_tpu.apps.scheduler import Scheduler
+    from bitcoin_miner_tpu.gateway import Gateway, SpanStore
+    from bitcoin_miner_tpu.lspnet.chaos import CHAOS, standard_scenarios
+    from bitcoin_miner_tpu.utils import sanitize
+    from bitcoin_miner_tpu.utils.metrics import METRICS
+
+    min_hash_range = WORKLOAD.min_range
+    # epoch_limit 10: burst loss must degrade the wire, not roll dice on
+    # WHICH miner gets disconnected — a leg that happens to lose its
+    # straggler for half the batch measures luck, not dispatch policy.
+    params = lsp.Params(10, 100, 5)
+    n_jobs, job_nonces = args.dc_jobs, args.dc_nonces
+    n_miners = args.dc_miners
+
+    def leg(tag: str, adaptive: bool) -> dict:
+        CHAOS.reset()
+        if args.chaos:
+            CHAOS.seed(args.chaos_seed)
+            CHAOS.run(
+                standard_scenarios(params.epoch_seconds)[args.chaos],
+                loop_every=args.chaos_loop,
+            )
+        before = METRICS.snapshot()
+        server = lsp.Server(0, params, label="server")
+        # Both legs share the straggler-re-queue policy (factor 4, floor
+        # 1 s) and the same upper chunk envelope: what differs is ONLY the
+        # dispatch plane under test.  The rate-based re-queue deadline is
+        # exactly as tight as chunk sizing lets it be — a right-sized
+        # 0.2 s straggler chunk times out in ~1 s, a fixed 4 s chunk not
+        # for 16 s — which is the point of the ladder.
+        if adaptive:
+            sched = Scheduler(
+                min_chunk=500,
+                max_chunk=args.dc_static_chunk,
+                target_chunk_seconds=args.dc_target_s,
+                straggler_min_seconds=1.0,
+                steal_factor=2.0,
+                steal_min_seconds=0.6,
+            )
+        else:
+            sched = Scheduler(
+                min_chunk=args.dc_static_chunk,
+                max_chunk=args.dc_static_chunk,
+                adaptive_chunks=False,
+                steal_factor=0.0,
+                straggler_min_seconds=1.0,
+            )
+        gw = Gateway(
+            sched, rate=None, spans=SpanStore(),
+            prefill=args.dc_prefill if adaptive else 0,
+            # Speculate only after a full second of continuous idleness:
+            # inter-job gaps in the sequential batch are not idleness.
+            prefill_idle_s=1.0,
+        )
+        lock = sanitize.make_lock(f"dispatch-compare.{tag}")
+        threading.Thread(
+            target=server_mod.serve,
+            args=(server, gw),
+            kwargs={"tick_interval": 0.1, "lock": lock},
+            daemon=True,
+        ).start()
+        stop = threading.Event()
+
+        def make_search(slow: bool):
+            # The induced straggler sweeps at dc_slow_rate nonces/s and,
+            # every dc_wedge_every_s seconds of wall time, its NEXT chunk
+            # wedges flat for dc_wedge_s (a stuck-runtime episode).  Time-
+            # based cadence: a per-chunk cadence would wedge more often
+            # the smaller its chunks, punishing the leg that sizes a slow
+            # miner down — the opposite of how real runtimes fail.  The
+            # cadence clock starts at the FIRST SERVED CHUNK, not at
+            # process setup: the straggler must first complete an honest
+            # slow chunk so the scheduler learns its rate — the regime
+            # under test is a known-slow miner whose fixed-size chunk
+            # rides under the rate-aware re-queue deadline (4x expected),
+            # not a cold miner the 1 s floor quarantines instantly.
+            state = {"wedge_at": None}
+
+            def search(d, lo, hi):
+                if slow:
+                    now = time.monotonic()
+                    if state["wedge_at"] is None:
+                        state["wedge_at"] = now + args.dc_wedge_every_s
+                    if now >= state["wedge_at"]:
+                        state["wedge_at"] = now + args.dc_wedge_every_s
+                        time.sleep(args.dc_wedge_s)  # live-but-hung chunk
+                    else:
+                        time.sleep((hi - lo + 1) / args.dc_slow_rate)
+                return min_hash_range(d, lo, hi)
+
+            return search
+
+        for i in range(n_miners):
+            threading.Thread(
+                target=miner_mod.run_miner_resilient,
+                args=("127.0.0.1", server.port, make_search(i == 0)),
+                kwargs={"params": params, "max_retries": 12,
+                        "backoff_base": 0.05, "backoff_cap": 0.5,
+                        "label": f"miner-{i}", "stop": stop},
+                daemon=True,
+            ).start()
+        util: list = []
+        sampling = threading.Event()
+
+        def sampler() -> None:
+            while not stop.is_set():
+                if sampling.is_set():
+                    with lock:
+                        st = gw.stats()
+                    # Only while a real request is in flight: inter-job
+                    # wire gaps would otherwise penalize the FASTER leg
+                    # (same wall-clock gap over a shorter wall).
+                    if st["miners"] and st["gw_inflight"]:
+                        util.append(
+                            (st["miners"] - st["idle_miners"]) / st["miners"]
+                        )
+                time.sleep(0.05)
+
+        threading.Thread(target=sampler, daemon=True).start()
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                with lock:
+                    if gw.stats()["miners"] == n_miners:
+                        break
+                time.sleep(0.05)
+            else:
+                raise RuntimeError(f"{tag}: miners never joined")
+
+            jobs = [(f"dc-{tag}-{i}", job_nonces - 1) for i in range(n_jobs)]
+            results: dict = {}
+            cursor = [0]
+            qlock = threading.Lock()
+
+            def worker(w: int) -> None:
+                while True:
+                    with qlock:
+                        if cursor[0] >= len(jobs):
+                            return
+                        i = cursor[0]
+                        cursor[0] += 1
+                    data, mx = jobs[i]
+                    results[data] = client_mod.request_with_retry(
+                        "127.0.0.1", server.port, data, mx,
+                        retries=8, backoff_base=0.1, params=params,
+                        label=f"client-{tag}-{w}",
+                    )
+
+            sampling.set()
+            t0 = time.monotonic()
+            workers = [
+                threading.Thread(target=worker, args=(w,), daemon=True)
+                for w in range(max(1, args.dc_clients))
+            ]
+            for t in workers:
+                t.start()
+            for t in workers:
+                t.join(timeout=args.dc_deadline)
+            wall = time.monotonic() - t0
+            sampling.clear()
+            if any(t.is_alive() for t in workers):
+                raise RuntimeError(f"{tag}: batch exceeded {args.dc_deadline}s")
+            for data, mx in jobs:
+                want = min_hash_range(data, 0, mx)
+                if results.get(data) != want:
+                    raise RuntimeError(
+                        f"{tag}: {data} got {results.get(data)}, want {want}"
+                    )
+            out = {
+                "wall_s": round(wall, 3),
+                "jobs_per_sec": round(n_jobs / wall, 3),
+                "utilization_mean": round(
+                    sum(util) / len(util), 3) if util else None,
+            }
+
+            if adaptive:
+                out.update(_dispatch_probes(
+                    gw, lock, server.port, params, jobs[0][0], job_nonces,
+                    args, min_hash_range,
+                ))
+        finally:
+            stop.set()
+            CHAOS.reset()
+            server.close()
+        after = METRICS.snapshot()
+        for k in ("sched.steals", "sched.chunk_size_adapt",
+                  "sched.prefill_chunks", "sched.chunks_straggler_requeued",
+                  "gateway.prefill_jobs", "gateway.prefill_preempted"):
+            delta = after.get(k, 0) - before.get(k, 0)
+            if delta or adaptive:
+                out[k] = delta
+        return out
+
+    def _dispatch_probes(
+        gw, lock, port, params, hot_data, job_nonces, args, min_hash_range
+    ) -> dict:
+        """Zero-chunk probes on the adaptive leg's live fleet: exact
+        repeat (cache), solved sub-range (spans — also marks the key hot),
+        then a query overlapping the speculative extension the idle fleet
+        prefilled past the hot span."""
+        probes: dict = {}
+
+        def zero_chunk_request(mx: int):
+            # Real (non-speculative) chunks only: the idle fleet may keep
+            # prefilling between probes, and those chunks are exactly the
+            # point — they must not read as the probe having swept.
+            def real_chunks() -> int:
+                return (
+                    METRICS.get("sched.chunks_assigned")
+                    - METRICS.get("sched.prefill_chunks")
+                )
+
+            before = real_chunks()
+            got = client_mod.request_with_retry(
+                "127.0.0.1", port, hot_data, mx,
+                retries=5, backoff_base=0.1, params=params,
+                label="client-probe",
+            )
+            return real_chunks() == before, got
+
+        zero, got = zero_chunk_request(job_nonces - 1)
+        probes["repeat_zero_chunks"] = (
+            zero and got == min_hash_range(hot_data, 0, job_nonces - 1)
+        )
+        sub_hi = job_nonces // 2 - 1
+        zero, got = zero_chunk_request(sub_hi)
+        probes["subrange_zero_chunks"] = (
+            zero and got == min_hash_range(hot_data, 0, sub_hi)
+        )
+        # Idle fleet: the serve ticker's gateway tick speculates past the
+        # hot span.  Wait until the extension's sweep lands in the store.
+        deadline = time.monotonic() + args.dc_deadline
+        ext_hi = job_nonces + args.dc_prefill // 2 - 1
+        covered = False
+        while time.monotonic() < deadline:
+            with lock:
+                _best, gaps = gw.spans.cover(hot_data, 0, ext_hi)
+            if not gaps:
+                covered = True
+                break
+            time.sleep(0.1)
+        probes["prefill_covered"] = covered
+        if covered:
+            zero, got = zero_chunk_request(ext_hi)
+            probes["prefill_zero_chunks"] = (
+                zero and got == min_hash_range(hot_data, 0, ext_hi)
+            )
+        else:
+            probes["prefill_zero_chunks"] = False
+        return probes
+
+    static = leg("static", adaptive=False)
+    adaptive = leg("adaptive", adaptive=True)
+    speedup = (
+        adaptive["jobs_per_sec"] / static["jobs_per_sec"]
+        if static["jobs_per_sec"] else None
+    )
+    log(f"static:   {static}")
+    log(f"adaptive: {adaptive}")
+    log(f"speedup: {speedup:.2f}x")
+    print(
+        json.dumps(
+            {
+                "metric": "dispatch_adaptive_speedup",
+                "value": round(speedup, 3),
+                "unit": "x vs static chunking",
+                "workload": WORKLOAD.name,
+                "jobs": n_jobs,
+                "job_nonces": job_nonces,
+                "miners": n_miners,
+                "induced_straggler": {
+                    "slow_rate_nps": args.dc_slow_rate,
+                    "wedge_every_s": args.dc_wedge_every_s,
+                    "wedge_s": args.dc_wedge_s,
+                },
+                **(
+                    {
+                        "chaos": {
+                            "scenario": args.chaos,
+                            "seed": args.chaos_seed,
+                            "loop_s": args.chaos_loop,
+                        }
+                    }
+                    if args.chaos
+                    else {}
+                ),
+                "static": static,
+                "adaptive": adaptive,
+                "utilization_gain": (
+                    round(
+                        adaptive["utilization_mean"]
+                        - static["utilization_mean"], 3,
+                    )
+                    if adaptive.get("utilization_mean") is not None
+                    and static.get("utilization_mean") is not None
+                    else None
+                ),
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nonces", type=int, default=2 * 10**10)
@@ -644,6 +971,36 @@ def main() -> int:
         "--connect)",
     )
     ap.add_argument(
+        "--dispatch-compare",
+        action="store_true",
+        help="adaptive-dispatch leg (ISSUE 10): static fixed chunking vs "
+        "the 10^k ladder + straggler-tail stealing + span prefill on an "
+        "in-process loopback fleet with an induced straggler (combine "
+        "with --chaos SCENARIO for the degraded-network artifact); "
+        "prints its own JSON line and exits",
+    )
+    ap.add_argument("--dc-jobs", type=int, default=12)
+    ap.add_argument("--dc-nonces", type=int, default=80_000,
+                    help="nonces per dispatch-compare job")
+    ap.add_argument("--dc-miners", type=int, default=3)
+    ap.add_argument("--dc-static-chunk", type=int, default=20_000,
+                    help="fixed chunk size of the static comparison leg")
+    ap.add_argument("--dc-target-s", type=float, default=0.1,
+                    help="adaptive leg per-chunk service-time target")
+    ap.add_argument("--dc-slow-rate", type=float, default=10_000.0,
+                    help="induced straggler's sweep rate (nonces/s)")
+    ap.add_argument("--dc-wedge-s", type=float, default=2.0,
+                    help="induced straggler's stuck-runtime episode length (s)")
+    ap.add_argument("--dc-wedge-every-s", type=float, default=1.0,
+                    help="seconds between the straggler's wedge episodes")
+    ap.add_argument("--dc-prefill", type=int, default=20_000,
+                    help="speculative prefill job size (adaptive leg)")
+    ap.add_argument("--dc-clients", type=int, default=1,
+                    help="concurrent client workers; 1 = sequential jobs, "
+                    "the regime where a straggler-held tail idles the "
+                    "healthy miners")
+    ap.add_argument("--dc-deadline", type=float, default=120.0)
+    ap.add_argument(
         "--federation",
         type=int,
         default=0,
@@ -666,6 +1023,17 @@ def main() -> int:
         # Subprocess fleets (MinerKeeper, server, federation cells) all
         # spawn with {**os.environ}: one export reaches every process.
         os.environ["BMT_WORKLOAD"] = WORKLOAD.name
+
+    if args.dispatch_compare:
+        if args.chaos:
+            from bitcoin_miner_tpu.lspnet.chaos import standard_scenarios
+
+            if args.chaos not in standard_scenarios():
+                raise SystemExit(
+                    f"unknown --chaos scenario {args.chaos!r}; valid: "
+                    f"{sorted(standard_scenarios())}"
+                )
+        return run_dispatch_compare(args)
 
     if args.federation:
         return run_federation_bench(args)
