@@ -20,8 +20,9 @@ from __future__ import annotations
 
 import logging
 import os
+import signal
 import sys
-import time
+import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..federation import GossipSpanStore, Replica
@@ -137,12 +138,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         f"(federation port {replica.fed_port})",
         flush=True,
     )
+    # Graceful drain on SIGTERM (ISSUE 12): stop admitting, broadcast
+    # DRAINING, flush span deltas, hand the orphan stash + in-flight job
+    # identities to the ring successor, THEN exit — a SIGTERM'd cell
+    # loses no resumable progress.  SIGKILL remains the crash drill.
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda signum, frame: stop.set())
     try:
-        while True:
-            time.sleep(1.0)
+        while not stop.wait(0.5):
+            pass
     except KeyboardInterrupt:
         pass
     finally:
+        if stop.is_set():
+            print(f"Replica {cell} draining", flush=True)
+            replica.drain(reason="SIGTERM")
         replica.close()
     return 0
 

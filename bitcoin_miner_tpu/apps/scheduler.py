@@ -708,6 +708,82 @@ class Scheduler:
             remaining = [tuple(iv) for iv in j["remaining"]]
             _merge_progress(self._resume, key, best, remaining)
 
+    # ----------------------------------------------------- drain handoff (ISSUE 12)
+
+    def export_orphans(self) -> dict:
+        """The drain-handoff payload: every resumable identity this cell
+        holds — the orphan stash plus every LIVE job's in-flight progress
+        (its best-so-far and remaining intervals under its ``(data,
+        lower, upper)`` identity).  Exactly the checkpoint snapshot,
+        workload-stamped: the ring successor imports it so a client
+        resubmitting a mid-batch job after this cell drains RESUMES from
+        the stashed progress instead of restarting from scratch."""
+        return self.checkpoint()
+
+    def import_orphans(self, state: dict) -> int:
+        """Merge a draining peer's :meth:`export_orphans` into the local
+        resume stash; returns identities accepted.  Unlike
+        :meth:`load_checkpoint` (trusted local disk) this payload crossed
+        the network, so rows are validated like the gossip codec's — one
+        malformed row must not poison the rest.  Merging uses the same
+        conservative rules as every other progress merge (best min-folds,
+        remaining unions), and the stash bound still applies."""
+        payload = unwrap_state(state, self.workload_name)
+        if payload is None:
+            return 0  # foreign workload or torn payload: refuse wholesale
+        accepted = 0
+        jobs = payload.get("jobs")
+        if not isinstance(jobs, list):
+            return 0
+        for j in jobs:
+            if not isinstance(j, dict):
+                continue
+            data, lower, upper = (
+                j.get("data"), j.get("lower"), j.get("upper"),
+            )
+            if not isinstance(data, str) or not all(
+                isinstance(v, int) and not isinstance(v, bool)
+                for v in (lower, upper)
+            ):
+                continue
+            best_raw = j.get("best")
+            best: Optional[Tuple[int, int]] = None
+            if best_raw is not None:
+                if not (
+                    isinstance(best_raw, (list, tuple))
+                    and len(best_raw) == 2
+                    and all(
+                        isinstance(v, int) and not isinstance(v, bool)
+                        for v in best_raw
+                    )
+                ):
+                    continue
+                best = (best_raw[0], best_raw[1])
+            remaining: List[Interval] = []
+            bad = False
+            for iv in j.get("remaining", ()) or ():
+                if not (
+                    isinstance(iv, (list, tuple))
+                    and len(iv) == 2
+                    and all(
+                        isinstance(v, int) and not isinstance(v, bool)
+                        for v in iv
+                    )
+                ):
+                    bad = True
+                    break
+                remaining.append((iv[0], iv[1]))
+            if bad or (best is None and not remaining):
+                continue
+            _merge_progress(self._resume, (data, lower, upper), best, remaining)
+            accepted += 1
+            METRICS.inc("fed.handoff_jobs")
+        while len(self._resume) > self.orphan_cache_max:
+            self._resume.pop(next(iter(self._resume)))
+        if accepted:
+            self.revision += 1
+        return accepted
+
     # ------------------------------------------------------------------ internals
 
     def _reject_result(

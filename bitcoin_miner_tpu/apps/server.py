@@ -140,9 +140,22 @@ def serve(
             k: v
             for k, v in sorted(METRICS.snapshot().items())
             if v and k.startswith(("chaos.", "gateway.", "miner.reconnects",
-                                   "miner.tier_downgrades", "client.resubmits"))
+                                   "miner.tier_downgrades", "client.resubmits",
+                                   "federation.", "fed.", "gossip."))
         }
         line = f"health {sched.stats()} {counters} nps={recent_nps.rate():.3g}"
+        # Membership plane (ISSUE 12): per-peer state codes (0 OK,
+        # 1 SHEDDING, 2 DRAINING, 3 SUSPECT, 4 DEAD) — empty outside a
+        # federation cell, so a plain server's line is unchanged.
+        peer_states = {
+            k.rsplit(".", 1)[1]: int(v)
+            for k, v in sorted(METRICS.gauges().items())
+            if k.startswith("fed.peer_state.")
+        }
+        if peer_states:
+            line += " fed_peers=" + ",".join(
+                f"{name}:{code}" for name, code in peer_states.items()
+            )
         # Latency distributions (ISSUE 6): request→result and chunk RTT
         # p50/p95/p99 ride the line, so "where does a request's time go"
         # is visible in log.txt without a trace file.  format_quantiles

@@ -21,22 +21,39 @@ M scheduler cells, where
   over LSP, framed with the telemetry fragmentation machinery
   (zlib + ``T1|id|i|n|chunk``) so every datagram respects the frozen
   1000-byte wire ceiling — a range solved anywhere answers everywhere,
-  bit-exact under the interval store's argmin-inside-query rule.
+  bit-exact under the interval store's argmin-inside-query rule;
+- :class:`~bitcoin_miner_tpu.federation.membership.Membership` (ISSUE 12)
+  is the resilience plane: gossip-piggybacked heartbeats carrying
+  ``(incarnation, load_state)``, a suspicion-based failure detector
+  (miss-count + confirmation window — a SHEDDING peer is deprioritized,
+  never declared dead), per-peer gossip acks with delta retransmit, and
+  graceful drain with work handoff to the ring successor.
 
 ``python -m bitcoin_miner_tpu.apps.federation`` runs one replica;
 ``tools/loadgen.py --federation N`` benches a whole federation in
 process (BENCH_pr8.json).
 """
 
-from .gossip import GossipSpanStore, SpanGossip, decode_gossip, encode_gossip
+from .gossip import (
+    GossipSpanStore,
+    SpanGossip,
+    decode_fed,
+    decode_gossip,
+    encode_gossip,
+    encode_handoff,
+)
+from .membership import Membership
 from .replica import Replica
 from .ring import Ring
 
 __all__ = [
     "GossipSpanStore",
+    "Membership",
     "Replica",
     "Ring",
     "SpanGossip",
+    "decode_fed",
     "decode_gossip",
     "encode_gossip",
+    "encode_handoff",
 ]

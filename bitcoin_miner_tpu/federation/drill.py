@@ -1,0 +1,421 @@
+"""Seeded federation resilience drills (ISSUE 12).
+
+In-process drills over real :class:`Replica` cells on loopback LSP —
+the membership plane's acceptance scenarios, shared by
+``tools/fleet_bench.py --federation`` (which stamps their verdicts into
+the BENCH JSON) and ``tools/chaos_replay.py --fed-drill NAME`` (which
+replays one from its seed under a debugger):
+
+- ``shed-storm`` — a cell flooded into SHEDDING via admission
+  backpressure stays routable and is never suspected or marked down
+  (``fed.false_suspicions == 0``: backpressure is not death);
+- ``drain-handoff`` — a cell drained mid-sweep hands its orphan stash
+  to the ring successor; the resubmitted job answers bit-exact with
+  STRICTLY fewer nonces swept than a from-scratch control (stashed
+  progress honored);
+- ``death-detect`` — an abruptly-killed cell is suspected, then
+  declared dead inside the confirmation window, by missed heartbeats
+  alone (zero forward-path connect timeouts spent);
+- ``ack-retransmit`` — a gossip partition heals and the peer converges
+  via ack-gap retransmit with the anti-entropy full sync disabled
+  (``full_every=10**9``): lost deltas no longer wait for it.
+
+Every drill returns ``{"name", "ok", ...evidence...}``; ``run_all``
+runs the lot.  Counters are process-global, so drills snapshot deltas
+and run one fleet at a time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import lsp
+from ..apps import client as client_mod
+from ..apps import miner as miner_mod
+from ..apps.scheduler import Scheduler
+from ..bitcoin.hash import min_hash_range
+from ..lspnet.chaos import CHAOS
+from ..utils.metrics import METRICS
+from .membership import ALIVE, DEAD, LOAD_SHEDDING
+from .replica import Replica
+from .ring import Ring
+
+DRILLS = ("shed-storm", "drain-handoff", "death-detect", "ack-retransmit")
+
+_PARAMS = lsp.Params(epoch_limit=5, epoch_millis=200, window_size=5)
+
+
+def _wait(pred: Callable[[], bool], timeout: float, interval: float = 0.05) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Fleet:
+    """Two-replica in-process federation with injectable miner search
+    functions (a drill needs sweeps slow enough to interrupt)."""
+
+    def __init__(self, **kw) -> None:
+        names = ["r0", "r1"]
+        fed_ports = {nm: _free_port() for nm in names}
+        per_cell = kw.pop("per_cell", {})
+        self.replicas: Dict[str, Replica] = {}
+        for nm in names:
+            peers = {o: ("127.0.0.1", fed_ports[o]) for o in names if o != nm}
+            self.replicas[nm] = Replica(
+                nm,
+                peers,
+                fed_port=fed_ports[nm],
+                params=_PARAMS,
+                scheduler=Scheduler(min_chunk=kw.get("min_chunk", 500)),
+                gossip_interval=kw.get("gossip_interval", 0.15),
+                suspect_misses=kw.get("suspect_misses", 3.0),
+                confirm_misses=kw.get("confirm_misses", 3.0),
+                gossip_full_every=kw.get("gossip_full_every", 4),
+                tick_interval=0.05,
+                peer_down_ttl=kw.get("peer_down_ttl", 2.0),
+                forward_timeout=kw.get("forward_timeout", 15.0),
+                **per_cell.get(nm, {}),
+            ).start()
+        self._miners: List["lsp.Client"] = []
+
+    def add_miner(self, name: str, search=None) -> None:
+        c = lsp.Client(
+            "127.0.0.1", self.replicas[name].port, _PARAMS,
+            label=f"miner-{name}",
+        )
+        threading.Thread(
+            target=miner_mod.run_miner,
+            args=(c, search if search is not None else miner_mod.make_search("cpu")),
+            daemon=True,
+        ).start()
+        self._miners.append(c)
+
+    def request_at(
+        self, name: str, data: str, hi: int, lower: int = 0,
+        timeout: Optional[float] = None,
+    ) -> Optional[Tuple[int, int]]:
+        c = lsp.Client("127.0.0.1", self.replicas[name].port, _PARAMS)
+        try:
+            return client_mod.request_once(c, data, hi, lower=lower, timeout=timeout)
+        except (lsp.LspError, TimeoutError):
+            return None
+        finally:
+            try:
+                c.close()
+            except lsp.LspError:
+                pass
+
+    def home_key(self, name: str, prefix: str) -> str:
+        return self.home_keys(name, prefix, 1)[0]
+
+    def home_keys(self, name: str, prefix: str, n: int) -> List[str]:
+        ring = Ring(list(self.replicas))
+        out: List[str] = []
+        for i in range(4096):
+            key = f"{prefix}{i}"
+            if ring.home(key) == name:
+                out.append(key)
+                if len(out) == n:
+                    return out
+        raise RuntimeError(f"could not find {n} keys homed on {name}")
+
+    def close(self) -> None:
+        for rep in self.replicas.values():
+            rep.close()
+
+
+def _slow_search(rate: float):
+    """A miner search honest about ``rate`` nonces/s — slow enough that
+    a drill can interrupt a sweep mid-flight, deterministic in answer."""
+
+    def search(d: str, lo: int, hi: int):
+        time.sleep((hi - lo + 1) / rate)
+        return min_hash_range(d, lo, hi)
+
+    return search
+
+
+# ------------------------------------------------------------------- drills
+
+
+def drill_shed_storm(seed: int = 1) -> dict:
+    """Admission-flood one cell into SHEDDING: its peer must keep it
+    ALIVE (zero false suspicions), keep it in the routing order, and
+    never mark it down — then serve it normally once the storm passes."""
+    before = METRICS.snapshot()
+    fleet = _Fleet(per_cell={
+        # r1: tiny admission so the storm sheds fast; no miners, so the
+        # storm jobs squat the queue instead of completing.
+        "r1": dict(rate=0.5, max_queued=2),
+    })
+    storm_conns: List["lsp.Client"] = []
+    try:
+        r0, r1 = fleet.replicas["r0"], fleet.replicas["r1"]
+        storm_keys = fleet.home_keys("r1", "storm", 24)
+        fleet.add_miner("r0")
+        # Let both sides hear a healthy heartbeat first.
+        assert _wait(lambda: r0.membership.fresh("r1"), 5.0), "no heartbeat"
+        # The storm: distinct r1-home signatures flood r1's public port —
+        # enough past the burst allowance that the tiny backlog overflows
+        # into real sheds (each key must be r1-HOME or it would forward
+        # out instead of loading r1's admission plane).
+        from ..bitcoin.message import Message
+
+        for skey in storm_keys:
+            try:
+                c = lsp.Client("127.0.0.1", r1.port, _PARAMS)
+            except (lsp.LspError, OSError):
+                continue
+            c.write(Message.request(skey, 0, 10_000).marshal())
+            storm_conns.append(c)
+        shed_seen = _wait(lambda: r1.gateway.shed_count > 0, 10.0)
+        # The peer's view during the storm: SHEDDING travels on the
+        # heartbeat; liveness never degrades.
+        shedding_seen = _wait(
+            lambda: r0.membership.load("r1") == LOAD_SHEDDING, 5.0
+        )
+        time.sleep(1.0)  # several suspicion windows' worth of beats
+        liveness = r0.membership.liveness("r1")
+        with r0._down_lock:
+            marked_down = "r1" in r0._down
+        still_routable = "r1" in r0.membership.order(["r1"])
+        after = METRICS.snapshot()
+        false_susp = after.get("fed.false_suspicions", 0) - before.get(
+            "fed.false_suspicions", 0
+        )
+        ok = (
+            shed_seen
+            and shedding_seen
+            and liveness == ALIVE
+            and not marked_down
+            and still_routable
+            and false_susp == 0
+        )
+        return {
+            "name": "shed-storm",
+            "ok": bool(ok),
+            "shed_seen": bool(shed_seen),
+            "shedding_state_seen": bool(shedding_seen),
+            "liveness_during_storm": liveness,
+            "marked_down": bool(marked_down),
+            "still_routable": bool(still_routable),
+            "false_suspicions": int(false_susp),
+        }
+    finally:
+        for c in storm_conns:
+            try:
+                c.close()
+            except lsp.LspError:
+                pass
+        fleet.close()
+
+
+def drill_drain_handoff(seed: int = 1) -> dict:
+    """Drain a cell mid-sweep; the successor resumes the resubmitted job
+    from the handed-off stash: bit-exact, strictly fewer nonces swept
+    than a from-scratch control of the same shape."""
+    handoff0 = METRICS.get("fed.handoff_jobs")
+    fleet = _Fleet(min_chunk=200, gossip_interval=0.15)
+    try:
+        r0, r1 = fleet.replicas["r0"], fleet.replicas["r1"]
+        key = fleet.home_key("r1", "drain")
+        hi = 20_000
+        want = min_hash_range(key, 0, hi)
+        # Honest-but-slow miners: the sweep takes ~4 s, interruptible.
+        fleet.add_miner("r1", _slow_search(5_000.0))
+        fleet.add_miner("r0", _slow_search(5_000.0))
+        assert _wait(lambda: r0.membership.fresh("r1"), 5.0), "no heartbeat"
+        box: dict = {}
+        t = threading.Thread(
+            target=lambda: box.update(got=fleet.request_at("r1", key, hi)),
+            daemon=True,
+        )
+        swept0 = METRICS.get("sched.nonces_swept")
+        t.start()
+        # Mid-sweep: some chunks done, job not finished.
+        assert _wait(
+            lambda: METRICS.get("sched.nonces_swept") - swept0 >= 400, 30.0
+        ), "sweep never started"
+        r1.drain(reason="drill")
+        r1.close()
+        t.join(timeout=10.0)
+        handed = METRICS.get("fed.handoff_jobs") - handoff0
+        # The dead cell's client resubmits through the survivor.
+        swept1 = METRICS.get("sched.nonces_swept")
+        got = fleet.request_at("r0", key, hi, timeout=60.0)
+        resumed_swept = METRICS.get("sched.nonces_swept") - swept1
+        # From-scratch control: same shape, fresh key, nothing stashed.
+        ckey = fleet.home_key("r0", "scratch")
+        cwant = min_hash_range(ckey, 0, hi)
+        swept2 = METRICS.get("sched.nonces_swept")
+        cgot = fleet.request_at("r0", ckey, hi, timeout=60.0)
+        scratch_swept = METRICS.get("sched.nonces_swept") - swept2
+        ok = (
+            got == want
+            and cgot == cwant
+            and handed >= 1
+            and resumed_swept < scratch_swept
+        )
+        return {
+            "name": "drain-handoff",
+            "ok": bool(ok),
+            "bit_exact": got == want,
+            "handoff_jobs": int(handed),
+            "resumed_nonces_swept": int(resumed_swept),
+            "scratch_nonces_swept": int(scratch_swept),
+            "strictly_fewer": resumed_swept < scratch_swept,
+        }
+    finally:
+        fleet.close()
+
+
+def drill_death_detect(seed: int = 1) -> dict:
+    """SIGKILL-shaped death (abrupt close, no drain): the survivor
+    suspects, then declares the peer dead inside the confirmation
+    window — on missed heartbeats alone, with zero forward-path connect
+    timeouts spent."""
+    before = METRICS.snapshot()
+    fleet = _Fleet(gossip_interval=0.15, suspect_misses=3, confirm_misses=3)
+    try:
+        r0, r1 = fleet.replicas["r0"], fleet.replicas["r1"]
+        fleet.add_miner("r0")
+        assert _wait(lambda: r0.membership.fresh("r1"), 5.0), "no heartbeat"
+        key = fleet.home_key("r1", "death")
+        # Abrupt death: servers vanish, heartbeats stop (the in-process
+        # SIGKILL; fleet_bench's subprocess leg covers the literal one).
+        t_kill = time.monotonic()
+        r1.close()
+        window = (3 + 3) * 0.15 + 1.5  # suspect + confirm + beat slack
+        dead = _wait(lambda: r0.membership.liveness("r1") == DEAD, window + 3.0)
+        detect_s = time.monotonic() - t_kill
+        after = METRICS.snapshot()
+        suspected = after.get("fed.suspected", 0) - before.get("fed.suspected", 0)
+        timeouts = after.get("federation.forward_timeouts", 0) - before.get(
+            "federation.forward_timeouts", 0
+        )
+        # A request for the dead cell's key now skips the corpse outright
+        # (DEAD leaves the alive view): answered locally, no connect
+        # attempt burned.
+        want = min_hash_range(key, 0, 2_000)
+        got = fleet.request_at("r0", key, 2_000, timeout=30.0)
+        after2 = METRICS.snapshot()
+        failovers = after2.get("federation.forward_failovers", 0) - before.get(
+            "federation.forward_failovers", 0
+        )
+        ok = (
+            dead
+            and suspected >= 1
+            and timeouts == 0
+            and failovers == 0
+            and got == want
+        )
+        return {
+            "name": "death-detect",
+            "ok": bool(ok),
+            "declared_dead": bool(dead),
+            "detect_s": round(detect_s, 3),
+            "suspected": int(suspected),
+            "forward_timeouts": int(timeouts),
+            "forward_failovers": int(failovers),
+            "bit_exact": got == want,
+        }
+    finally:
+        fleet.close()
+
+
+def drill_ack_retransmit(seed: int = 1) -> dict:
+    """Partition one cell's gossip channel, solve a range, heal: the
+    peer converges via ack-gap retransmit with anti-entropy disabled
+    (``full_every=10**9``) — no full sync may fire."""
+    CHAOS.reset()
+    CHAOS.seed(seed)
+    before = METRICS.snapshot()
+    fleet = _Fleet(
+        min_chunk=500, gossip_interval=0.15, gossip_full_every=10**9,
+    )
+    try:
+        r0, r1 = fleet.replicas["r0"], fleet.replicas["r1"]
+        key = fleet.home_key("r1", "ackpart")
+        hi = 4_000
+        fleet.add_miner("r1")
+        want = min_hash_range(key, 0, hi)
+        # Wait for a live gossip conn (a heartbeat got through) FIRST:
+        # the drill needs the partition to swallow writes on an
+        # ESTABLISHED conn — the lost-delta regime acks exist for — not
+        # to block the initial connect (which would fail the send
+        # locally and never count as a loss).
+        assert _wait(lambda: r0.membership.fresh("r1"), 5.0), "no heartbeat"
+        # Cut r1's gossip tx BEFORE it solves: the delta beats for the
+        # solved spans go into the void (writes enqueue locally; the
+        # partition swallows the datagrams, then the conn dies).
+        CHAOS.partition("gossip-r1", "both")
+        assert fleet.request_at("r1", key, hi) == want
+
+        def r0_covered() -> bool:
+            with r0.lock:
+                best, gaps = r0.spans.cover(key, 0, hi)
+                return best is not None and not gaps
+
+        time.sleep(1.5)  # several beats: nothing may arrive
+        stale = not r0_covered()
+        CHAOS.heal("gossip-r1")
+        converged = _wait(r0_covered, 15.0)
+        after = METRICS.snapshot()
+        retrans = after.get("gossip.retransmits", 0) - before.get(
+            "gossip.retransmits", 0
+        )
+        fulls = after.get("federation.gossip_full_syncs", 0) - before.get(
+            "federation.gossip_full_syncs", 0
+        )
+        ok = stale and converged and retrans >= 1 and fulls == 0
+        return {
+            "name": "ack-retransmit",
+            "ok": bool(ok),
+            "stale_while_partitioned": bool(stale),
+            "converged_after_heal": bool(converged),
+            "retransmits": int(retrans),
+            "full_syncs": int(fulls),
+            "seed": seed,
+        }
+    finally:
+        fleet.close()
+        CHAOS.reset()
+
+
+_RUNNERS = {
+    "shed-storm": drill_shed_storm,
+    "drain-handoff": drill_drain_handoff,
+    "death-detect": drill_death_detect,
+    "ack-retransmit": drill_ack_retransmit,
+}
+
+
+def run_fed_drill(name: str, seed: int = 1) -> dict:
+    """Run one named resilience drill; raises ValueError on an unknown
+    name (the chaos_replay CLI contract)."""
+    runner = _RUNNERS.get(name)
+    if runner is None:
+        raise ValueError(
+            f"unknown federation drill {name!r}; valid: {', '.join(DRILLS)}"
+        )
+    return runner(seed=seed)
+
+
+def run_all(seed: int = 1) -> List[dict]:
+    return [run_fed_drill(name, seed=seed) for name in DRILLS]

@@ -45,7 +45,19 @@ from ..utils import sanitize
 from ..utils import trace as _trace
 from ..utils.metrics import METRICS
 from ..utils.telemetry import FrameAssembler
-from .gossip import GossipSpanStore, SpanGossip, apply_gossip, decode_gossip
+from .gossip import (
+    GossipSpanStore,
+    SpanGossip,
+    apply_gossip,
+    decode_fed,
+    encode_handoff,
+)
+from .membership import (
+    LOAD_DRAINING,
+    LOAD_OK,
+    LOAD_SHEDDING,
+    Membership,
+)
 from .ring import Ring
 
 #: Federation-port conns are offset into this id space before they meet
@@ -85,6 +97,10 @@ class _Router:
     # ------------------------------------------------------------------ events
 
     def miner_joined(self, conn_id: int, now: float = 0.0) -> List[Action]:
+        if self._r._draining:
+            # No new workers for a cell that is shipping its work away.
+            self._r._refused.append(conn_id)
+            return []
         if conn_id in self._r._fwd_conns:
             # Request-then-Join role confusion on a conn whose Request is
             # being forwarded: the gateway's own guard cannot see it (no
@@ -103,6 +119,13 @@ class _Router:
         client_key: Optional[str] = None,
     ) -> List[Action]:
         r = self._r
+        if r._draining:
+            # DRAINING stops admitting (ISSUE 12): close the conn so the
+            # client's retry lands on a peer — the broadcast DRAINING
+            # heartbeat already steered new forwards away.
+            r._refused.append(conn_id)
+            METRICS.inc("federation.drain_refused")
+            return []
         if conn_id in r._fwd_conns:
             return []  # one job per conn, forwarded or not
         if r.peers and lower <= upper and 0 <= lower and upper < 1 << 64:
@@ -192,8 +215,10 @@ class _Router:
     def drain_evictions(self) -> List[int]:
         """Public evictions are returned for the serve shell to close;
         federation-port evictions (a shed forwarded request) are closed
-        here on the federation server."""
-        out: List[int] = []
+        here on the federation server.  Drain-refused public conns ride
+        along — DRAINING means every new arrival is turned away."""
+        out: List[int] = list(self._r._refused)
+        self._r._refused = []
         for cid in self.gw.drain_evictions():
             if cid >= FED_BASE:
                 self._r._close_fed(cid - FED_BASE)
@@ -242,6 +267,9 @@ class Replica:
         forward_workers: int = 4,
         forward_timeout: float = 15.0,
         peer_down_ttl: float = 2.0,
+        suspect_misses: float = 3.0,
+        confirm_misses: float = 3.0,
+        incarnation: Optional[int] = None,
         workload=None,
         tick_interval: float = 0.25,
         checkpoint_path: Optional[str] = None,
@@ -277,10 +305,25 @@ class Replica:
         )
         self.lock = sanitize.make_lock(f"fed.{cell}.event")
         self.router = _Router(self)
+        # Membership plane (ISSUE 12): the suspicion-based failure
+        # detector every gossip heartbeat feeds; the gossip daemon ticks
+        # it once per interval.  Incarnations disambiguate restarts —
+        # wall-clock seconds are monotone enough across process lives.
+        self.membership = Membership(
+            cell, list(self.peers), interval=gossip_interval,
+            suspect_misses=suspect_misses, confirm_misses=confirm_misses,
+        )
+        self.incarnation = (
+            incarnation if incarnation is not None else int(time.time())
+        )
+        self._draining = False  # guarded-by: lock
+        self._refused: List[int] = []  # guarded-by: lock
+        self._last_shed = 0  # heartbeat-to-heartbeat shed delta base  # guarded-by: lock
         self.gossip = SpanGossip(
             cell, self.spans, self.peers, self.lock,
             interval=gossip_interval, full_every=gossip_full_every,
-            params=params,
+            params=params, membership=self.membership,
+            hb_fn=self._heartbeat,
         )
         self._tick_interval = tick_interval
         self._checkpoint_path = checkpoint_path
@@ -382,6 +425,77 @@ class Replica:
     def fed_port(self) -> int:
         return self.fed.port
 
+    # ----------------------------------------------------- membership (ISSUE 12)
+
+    def load_state(self) -> str:
+        """The load state this cell's heartbeat advertises: DRAINING once
+        :meth:`drain` started; SHEDDING while admission backpressure is
+        biting (sheds since the last heartbeat, or a deep backlog); OK
+        otherwise.  SHEDDING tells peers "alive, deprioritize" — the
+        whole point of the membership plane is that backpressure stops
+        reading as death."""
+        with self.lock:
+            if self._draining:
+                return LOAD_DRAINING
+            shed = self.gateway.shed_count
+            backlog = len(self.gateway._queue)
+            shedding = (
+                shed > self._last_shed
+                or backlog >= max(1, self.gateway.max_queued) // 2
+            )
+            self._last_shed = shed
+        return LOAD_SHEDDING if shedding else LOAD_OK
+
+    def _heartbeat(self) -> dict:
+        """The per-beat piggyback (gossip ``hb`` field)."""
+        return {"inc": self.incarnation, "load": self.load_state()}
+
+    def drain(self, reason: str = "drain") -> None:
+        """Graceful drain (ISSUE 12): stop admitting, broadcast DRAINING,
+        flush pending span deltas, and ship the scheduler's orphan stash
+        + in-flight job identities to the ring successor — so a client
+        resubmitting a mid-batch job at a survivor RESUMES from stashed
+        progress instead of restarting.  The caller still owns
+        :meth:`close` (the SIGTERM handler calls both)."""
+        with self.lock:
+            if self._draining:
+                return
+            self._draining = True
+        self._log.info("drain (%s): admitting stopped", reason)
+        _trace.emit(None, "fed", "drain", cell=self.cell, reason=reason)
+        # The gossip daemon owns the peer conns; stop it so this thread
+        # can use them (conn state is strictly single-threaded), then
+        # push one final beat: the DRAINING heartbeat plus any unacked
+        # span deltas — the flush peers would otherwise wait a beat for.
+        self.gossip.stop()
+        if self.peers:
+            try:
+                self.gossip.beat()
+            except Exception:
+                METRICS.inc("federation.gossip_errors")
+            succ = self.ring.successor(
+                self.cell, alive=self.membership.routable()
+            )
+            if succ is not None:
+                with self.lock:
+                    state = self.gateway.sched.export_orphans()
+                payload = state.get("state") if state.get("version") == 2 else state
+                jobs = len((payload or {}).get("jobs") or [])
+                frames = encode_handoff(self.cell, self.incarnation, state)
+                if self.gossip.send_to(succ, frames):
+                    METRICS.inc("federation.handoffs_sent")
+                    self._log.info(
+                        "drain: handed %d resumable identities to %s",
+                        jobs, succ,
+                    )
+                    _trace.emit(
+                        None, "fed", "handoff",
+                        cell=self.cell, successor=succ, jobs=jobs,
+                    )
+                else:
+                    METRICS.inc("federation.gossip_errors")
+                    self._log.info("drain: handoff to %s failed", succ)
+
     # ------------------------------------------------------------- transport
 
     def _emit_public(self, actions: List[Action]) -> None:
@@ -441,19 +555,69 @@ class Replica:
                 done, obj = asm.feed(payload)
                 if not done:
                     continue
-                msg = decode_gossip(obj)
+                msg = decode_fed(obj)
                 if msg is None:
                     METRICS.inc("federation.gossip_errors")
                     continue
+                sender = msg["from"]
+                if msg["kind"] == "handoff":
+                    # A draining peer shipped its orphan stash + in-flight
+                    # identities (ISSUE 12): merge into the local resume
+                    # stash so resubmitted jobs RESUME here.
+                    with self.lock:
+                        accepted = self.gateway.sched.import_orphans(
+                            msg["state"]
+                        )
+                    self._log.info(
+                        "handoff from %s: %d resumable identities",
+                        sender, accepted,
+                    )
+                    _trace.emit(
+                        None, "fed", "handoff_rx",
+                        cell=self.cell, peer=sender, jobs=accepted,
+                    )
+                    continue
                 METRICS.inc("federation.gossip_rx")
+                # Heartbeat first (outside the event lock — membership has
+                # its own): liveness + load state feed the failure
+                # detector; a restarted incarnation voids the peer's seq
+                # bookkeeping (its journal numbering started over).
+                hb = msg.get("hb")
+                restarted = False
+                if isinstance(hb, dict):
+                    inc = hb.get("inc", 0)
+                    if not isinstance(inc, int) or isinstance(inc, bool):
+                        inc = 0  # garbage incarnation: still a heartbeat
+                    restarted = self.membership.heard(
+                        sender, str(hb.get("load", LOAD_OK)), inc,
+                    )
                 with self.lock:
+                    if restarted:
+                        self.spans.reset_peer(sender)
                     merged = apply_gossip(self.spans, msg)
+                    # Ack bookkeeping (ISSUE 12): the message covers the
+                    # sender's journal through jseq (ours to ack back);
+                    # its ack field covers OUR journal (prune retention).
+                    jseq = msg.get("jseq")
+                    if isinstance(jseq, int) and not isinstance(jseq, bool):
+                        self.spans.record_seen(sender, jseq)
+                    ack = msg.get("ack")
+                    if isinstance(ack, int) and not isinstance(ack, bool):
+                        self.spans.record_ack(sender, ack)
                 if merged:
                     METRICS.inc("federation.gossip_spans_merged", merged)
                 continue
             m = Message.unmarshal(payload)
             if m is None or m.type != MsgType.REQUEST:
                 continue  # peers only forward Requests here
+            with self.lock:
+                draining = self._draining
+            if draining:
+                # Stopped admitting: refuse the forwarded request so the
+                # peer fails over (its membership view is about to agree).
+                fed_keys.pop(conn_id, None)
+                self._close_fed(conn_id)
+                continue
             now = self._clock()
             # End-to-end admission identity: the preamble's origin key if
             # one preceded this Request (consumed — the next Request on
@@ -503,7 +667,17 @@ class Replica:
                     return
                 conn_id, data, lower, upper, ckey, t0 = task
                 result = None
-                order = [n for n in self.ring.route(data) if n != self.cell]
+                # Membership drives routing (ISSUE 12): confirmed-DEAD
+                # peers leave the alive view, then the load ranking puts
+                # SHEDDING peers last-resort and drops DRAINING ones —
+                # the per-forward connect timeout is now the LAST liveness
+                # signal, not the only one.
+                route = self.ring.route(
+                    data, alive=self.membership.routable()
+                )
+                order = self.membership.order(
+                    [n for n in route if n != self.cell]
+                )
                 candidates = [n for n in order if not self._peer_is_down(n)]
                 for name in candidates:
                     try:
@@ -520,6 +694,18 @@ class Replica:
                     if result is not None:
                         self._mark_peer(name, down=False)
                         break
+                    if self.membership.fresh(name):
+                        # The conn died but heartbeats prove the peer
+                        # alive: that is backpressure (it shed us) or a
+                        # transport hiccup — deprioritize by moving on,
+                        # WITHOUT the death marking that used to blind
+                        # this cell to a healthy home for the down-TTL.
+                        METRICS.inc("federation.shed_skips")
+                        _trace.emit(
+                            None, "fed", "shed_skip",
+                            cell=self.cell, peer=name, data=data[:64],
+                        )
+                        continue
                     self._mark_peer(name, down=True)
                     METRICS.inc("federation.forward_failovers")
                     _trace.emit(

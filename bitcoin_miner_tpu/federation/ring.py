@@ -79,3 +79,21 @@ class Ring:
             if kept:
                 return kept
         return order
+
+    def successor(
+        self, name: str, alive: Optional[Sequence[str]] = None
+    ) -> Optional[str]:
+        """The next DISTINCT replica clockwise from ``name``'s first
+        vnode — the deterministic heir a draining cell hands its orphan
+        stash to (ISSUE 12).  Every replica derives the same ring, so
+        survivors agree on who inherited.  ``alive`` filters candidates;
+        None when the ring has no other (living) member."""
+        if name not in self.names or len(self.names) == 1:
+            return None
+        h = _point(f"{name}#0")
+        start = bisect_right(self._keys, h) % len(self._points)
+        for i in range(len(self._points)):
+            cand = self._points[(start + i) % len(self._points)][1]
+            if cand != name and (alive is None or cand in alive):
+                return cand
+        return None

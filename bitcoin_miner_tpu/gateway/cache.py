@@ -35,8 +35,9 @@ alongside the scheduler's partial-progress checkpoint.  Evictions bump
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..utils.intervals import Interval, IntervalMap
 from ..utils.metrics import METRICS
@@ -157,12 +158,19 @@ class SpanStore:
     thread-safe by itself — the gateway serializes access under the
     server shell's event lock, like every other policy structure."""
 
+    #: Decayed hotness below this is cold again: the key stops competing
+    #: for idle prefill capacity (one fresh hit sits at 1.0, so a single
+    #: half-life idles a one-hit key out).
+    HOT_MIN = 0.5
+
     def __init__(
         self,
         capacity: int = 512,
         max_spans_per_data: int = 64,
         path: Optional[str] = None,
         workload: Optional[str] = None,
+        hot_half_life_s: Optional[float] = 600.0,
+        clock=time.monotonic,
     ) -> None:
         self.capacity = max(0, int(capacity))
         self.max_spans_per_data = max(1, int(max_spans_per_data))
@@ -170,16 +178,36 @@ class SpanStore:
         # Same per-workload stamp contract as ResultCache (ISSUE 9).
         self.workload_name = workload or DEFAULT_WORKLOAD
         self._maps: "OrderedDict[str, IntervalMap]" = OrderedDict()
-        # Hotness (ISSUE 10): per-data count of cover() plans that found
-        # usable coverage — the speculative-prefill planner sweeps gaps
-        # adjacent to the HOTTEST keys first.  Ephemeral (not persisted):
+        # Hotness (ISSUE 10): per-data cover()-reuse score — the
+        # speculative-prefill planner sweeps gaps adjacent to the HOTTEST
+        # keys first.  Recency-weighted (ISSUE 12 satellite): scores
+        # decay with ``hot_half_life_s`` (None disables), so a
+        # formerly-hot key stops hogging idle prefill capacity and a
+        # newly-hot one overtakes it.  Ephemeral (not persisted):
         # hotness is a property of the query stream, not of solved work.
-        self._hits: dict = {}
+        self.hot_half_life_s = hot_half_life_s
+        self._clock = clock
+        self._hits: Dict[str, Tuple[float, float]] = {}  # data -> (score, t)
         self._prefilled: dict = {}  # data -> nonces speculatively extended
         self._ext_live: dict = {}  # data -> charged-but-unswept extension
         self._dirty = False
         if path is not None:
             self._load(path)
+
+    def _hot(self, data: str, now: Optional[float] = None) -> float:
+        """The decayed hotness score (0.0 for a never-hit key)."""
+        ent = self._hits.get(data)
+        if ent is None:
+            return 0.0
+        score, t = ent
+        if not self.hot_half_life_s:
+            return score
+        now = self._clock() if now is None else now
+        return score * 0.5 ** (max(0.0, now - t) / self.hot_half_life_s)
+
+    def _mark_hot(self, data: str) -> None:
+        now = self._clock()
+        self._hits[data] = (self._hot(data, now) + 1.0, now)
 
     def __len__(self) -> int:
         """Total solved spans across every data key."""
@@ -229,7 +257,7 @@ class SpanStore:
         if best is not None:
             # A plan that reused solved spans marks the key hot — the
             # speculative-prefill planner's ranking signal (ISSUE 10).
-            self._hits[data] = self._hits.get(data, 0) + 1
+            self._mark_hot(data)
         return best, gaps
 
     def prefill_target(
@@ -246,9 +274,13 @@ class SpanStore:
         if self.capacity == 0 or size <= 0:
             return None
         cap = max_extend if max_extend is not None else 8 * size
-        for data in sorted(self._hits, key=lambda d: -self._hits.get(d, 0)):
+        now = self._clock()
+        hot = {d: self._hot(d, now) for d in self._hits}
+        for data in sorted(hot, key=lambda d: -hot[d]):
             m = self._maps.get(data)
-            if m is None or self._hits.get(data, 0) <= 0:
+            if m is None or hot[data] < self.HOT_MIN:
+                # Decayed cold: a key nobody reuses anymore must not hog
+                # idle prefill capacity (ISSUE 12 satellite).
                 continue
             spans = m.spans()
             if not spans:

@@ -133,6 +133,10 @@ class Gateway:
         self._queue = FairQueue()
         self._buckets: Dict[str, TokenBucket] = {}
         self._shed: List[int] = []
+        #: Monotone per-GATEWAY shed count (the process METRICS counter is
+        #: shared by every in-process cell): the federation heartbeat's
+        #: SHEDDING evidence — backpressure HERE, not somewhere else.
+        self.shed_count = 0
         self._next_vid = -1  # virtual ids count down; real conn ids are > 0
         # Speculative span prefill (ISSUE 10): when the fleet is fully
         # idle, feed the scheduler ``prefill``-nonce synthetic gap-sweeps
@@ -704,6 +708,7 @@ class Gateway:
         if len(self._queue) >= self.max_queued:
             victim = self._queue.shed_from_largest()
             METRICS.inc("gateway.shed")
+            self.shed_count += 1
             if victim is None:
                 self._shed.append(conn_id)
                 _trace.emit(tid, "gw", "shed", conn=conn_id)

@@ -17,20 +17,35 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Callable, Coroutine, Optional, Tuple
 
-from ..utils import trace
+from ..utils import sanitize, trace
 from .aio import AsyncClient, AsyncServer
 from .errors import ConnClosedError
 from .params import Params
 
 
 class _LoopThread:
-    """A daemon thread running a private asyncio loop."""
+    """A daemon thread running a private asyncio loop.
+
+    Under ``BMT_SANITIZE=1`` the loop joins the sanitizer's
+    acquisition-order graph as a lock-shaped resource (ISSUE 12
+    carry-over): blocking proxy calls record ``held-locks -> loop``
+    edges, the loop thread itself "holds" its loop name so callbacks
+    taking tracked locks record ``loop -> lock``, and a cycle — the
+    Future-spelled ABBA deadlock between the serve event lock and an
+    LSP loop — raises deterministically.  Calling ``run``/``call`` FROM
+    the owning loop thread (a guaranteed self-deadlock: the Future can
+    never resolve while its own loop blocks on it) raises RaceError
+    outright."""
 
     def __init__(self, name: str) -> None:
         self.loop = asyncio.new_event_loop()
         self._stopping = False
+        self._san = sanitize.enabled()  # captured once: per-call env reads are hot-path cost
+        self._san_name = f"lsp.loop.{name}"
 
         def _run() -> None:
+            if self._san:
+                sanitize.loop_thread_enter(self._san_name)
             try:
                 self.loop.run_forever()
             finally:
@@ -50,6 +65,8 @@ class _LoopThread:
         self._thread.start()
 
     def run(self, coro: "Coroutine", timeout: Optional[float] = None) -> Any:
+        if self._san:
+            self._observe_entry("run")
         if self._stopping:
             coro.close()
             raise ConnClosedError()
@@ -75,9 +92,22 @@ class _LoopThread:
             fut.cancel()
             raise TimeoutError(f"no result within {timeout:g}s")
 
+    def _observe_entry(self, what: str) -> None:
+        """Sanitizer coverage for a blocking proxy call (see class
+        docstring): refuse self-deadlocks, record lock-order edges."""
+        if threading.current_thread() is self._thread:
+            raise sanitize.RaceError(
+                f"{self._san_name}.{what}() called from its own loop "
+                f"thread — the blocking Future can never resolve while "
+                f"the loop waits on it (guaranteed deadlock)"
+            )
+        sanitize.loop_wait(self._san_name)
+
     def call(self, fn: Callable, *args: Any) -> Any:
         """Run a plain callable on the loop thread (for non-async mutations
         that must happen on the owning loop)."""
+        if self._san:
+            self._observe_entry("call")
         done: Future = Future()
 
         def _invoke() -> None:

@@ -341,6 +341,16 @@ def format_quantiles(h) -> str:
 #:   federation.gossip_rx      span-gossip messages received and decoded
 #:   federation.gossip_spans_merged  peer spans folded into the local span store
 #:   federation.gossip_errors  gossip sends/decodes/beats that failed
+#:   federation.gossip_full_syncs  full-state anti-entropy beats sent (cycle or lag escalation)
+#:   federation.shed_skips     forwards refused by a peer whose heartbeats prove it alive
+#:   federation.drain_refused  requests turned away by a DRAINING cell
+#:   federation.handoffs_sent  drain handoffs shipped to the ring successor
+#:   fed.heartbeats            gossip heartbeats received from peers
+#:   fed.suspected             peers marked SUSPECT by the failure detector
+#:   fed.false_suspicions      suspects that heartbeat again before the confirmation window
+#:   fed.handoff_jobs          resumable identities imported from a draining peer
+#:   fed.peer_state            per-peer membership gauge (fed.peer_state.<peer>: 0 OK .. 4 DEAD)
+#:   gossip.retransmits        unacked delta spans resent by the ack-gap recovery
 #:   miner.nonces              nonces swept by this process's miner loop
 #:   miner.reconnects          successful re-Joins after a lost server conn
 #:   miner.tier_downgrades     kernel tiers abandoned by the sweep watchdog

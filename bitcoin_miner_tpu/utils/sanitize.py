@@ -44,6 +44,8 @@ __all__ = [
     "force",
     "make_lock",
     "guard",
+    "loop_thread_enter",
+    "loop_wait",
     "reset_order_graph",
 ]
 
@@ -184,6 +186,43 @@ def make_lock(name: str) -> Any:
     """The serve loop's lock factory: tracked when sanitizing, plain
     ``threading.Lock`` (zero overhead) otherwise."""
     return TrackedLock(name) if enabled() else threading.Lock()
+
+
+# --------------------------------------------------------------------------
+# Event-loop thread coverage (ISSUE 12 carry-over satellite)
+#
+# The LSP sync facades (lsp/sync.py) proxy every call onto a private
+# asyncio loop thread and BLOCK on the result — which makes each loop a
+# lock-shaped resource the acquisition-order graph could not see: a
+# thread holding the serve event lock that blocks on a loop whose
+# callbacks ever take that event lock is the classic ABBA deadlock, just
+# spelled with a Future instead of a second ``with``.  Under
+# BMT_SANITIZE=1 the loop joins the graph:
+#
+# - the loop thread marks itself as permanently "holding" its own loop
+#   name (``loop_thread_enter``), so any TrackedLock acquired by code
+#   running ON the loop thread records the edge ``loop -> lock``;
+# - every cross-thread blocking proxy call records ``held -> loop``
+#   (``loop_wait``), so blocking on the loop while holding a lock its
+#   callbacks acquire closes the cycle and raises LockOrderError
+#   deterministically — whether or not this run interleaved badly.
+# --------------------------------------------------------------------------
+
+
+def loop_thread_enter(name: str) -> None:
+    """Mark the CURRENT thread as an event-loop thread that permanently
+    holds the loop resource ``name`` (called once, from the loop thread
+    itself, before the loop runs)."""
+    if enabled():
+        _held_stack().append(name)
+
+
+def loop_wait(name: str) -> None:
+    """A cross-thread call is about to BLOCK on loop ``name``: record the
+    acquisition-order edges from every lock the caller holds, exactly as
+    if the loop were a lock being acquired."""
+    if enabled():
+        _ORDER.observe(tuple(_held_stack()), name)
 
 
 # --------------------------------------------------------------------------

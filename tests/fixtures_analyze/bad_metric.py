@@ -10,6 +10,9 @@
 - ``fleet.fixture_sources`` is a fleet-view gauge (``fleet.*`` names are
   gauge-kind, ISSUE 7) but emitted via ``inc``
   (``metric-kind-mismatch``);
+- ``fed.peer_state.fixture`` is a membership gauge (the
+  ``fed.peer_state`` family is gauge-kind, ISSUE 12) but emitted via
+  ``inc`` (``metric-kind-mismatch``);
 - the computed-name ``inc`` cannot be registry-checked at all
   (``metric-dynamic-name``).
 """
@@ -31,6 +34,7 @@ class Metrics:  # stand-in so the fixture never imports the real package
 #:   fixture.documented_only   documented here, emitted nowhere
 #:   hist.fixture_latency      a histogram name (observe-only kind)
 #:   fleet.fixture_sources     a fleet-view gauge (set_gauge-only kind)
+#:   fed.peer_state.fixture    a membership gauge (set_gauge-only kind)
 METRICS = Metrics()
 
 
@@ -38,4 +42,5 @@ def provoke_metric_drift(suffix: str) -> None:
     METRICS.inc("fixture.never_documented")  # undocumented counter
     METRICS.inc("hist.fixture_latency")  # wrong emitter for a hist.* name
     METRICS.inc("fleet.fixture_sources")  # wrong emitter for a fleet.* gauge
+    METRICS.inc("fed.peer_state.fixture")  # wrong emitter for a membership gauge
     METRICS.inc("fixture." + suffix)  # dynamic name: unverifiable

@@ -189,6 +189,11 @@ def test_metrics_rules_fire_on_fixture():
     assert ("metric-kind-mismatch", "fleet.fixture_sources") in {
         (f.rule, f.symbol) for f in findings
     }
+    # fed.peer_state.* is the membership gauge family (ISSUE 12): inc()
+    # on one must fire too, while the rest of fed.* stays counter-kind.
+    assert ("metric-kind-mismatch", "fed.peer_state.fixture") in {
+        (f.rule, f.symbol) for f in findings
+    }
 
 
 def test_metrics_pass_honors_metric_ok_declaration(tmp_path):
@@ -244,6 +249,119 @@ def test_trace_pass_does_not_flag_static_branches(tmp_path):
         "    return jax.jit(kernel)\n"
     )
     assert _pass_findings("trace", tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# 2b. lockcheck --fix: the mechanical lock fixer (ISSUE 12 carry-over)
+# --------------------------------------------------------------------------
+
+
+_FIXABLE = """\
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded-by: _lock
+
+    def bump(self):
+        self._n += 1
+
+    def read(self):
+        return self._n
+"""
+
+_UNFIXABLE = """\
+import threading
+
+
+class Scanner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded-by: _lock
+
+    def spin(self):
+        while self._n < 10:
+            pass
+"""
+
+
+def _run_lockfix(root, *extra):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "lockcheck", "--fix",
+         "--root", str(root), *extra],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_lockfix_wraps_safe_findings_and_recheck_is_clean(tmp_path):
+    """Direction 1: simple-statement findings are mechanically wrapped in
+    `with self._lock:` and the lock pass then finds nothing."""
+    (tmp_path / "fixme.py").write_text(_FIXABLE)
+    res = _run_lockfix(tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    fixed = (tmp_path / "fixme.py").read_text()
+    assert fixed.count("with self._lock:") == 2
+    assert "with self._lock:\n            self._n += 1" in fixed
+    assert "with self._lock:\n            return self._n" in fixed
+    assert _pass_findings("lock", tmp_path) == []  # idempotent + clean
+    res2 = _run_lockfix(tmp_path)
+    assert res2.returncode == 0
+    assert (tmp_path / "fixme.py").read_text() == fixed  # nothing to redo
+
+
+def test_lockfix_refuses_compound_headers_and_emits_review_diff(tmp_path):
+    """Direction 2: an access in a loop header cannot be wrapped without
+    changing control flow — the file stays byte-identical and the
+    annotated context block names the spot for review."""
+    (tmp_path / "scanner.py").write_text(_UNFIXABLE)
+    res = _run_lockfix(tmp_path)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert (tmp_path / "scanner.py").read_text() == _UNFIXABLE
+    assert "NOT auto-fixable" in res.stdout
+    assert "scanner.py" in res.stdout and "Scanner._n" in res.stdout
+    assert "while self._n < 10:" in res.stdout  # the annotated context
+
+
+def test_lockfix_dry_run_touches_nothing(tmp_path):
+    (tmp_path / "fixme.py").write_text(_FIXABLE)
+    res = _run_lockfix(tmp_path, "--dry-run")
+    assert (tmp_path / "fixme.py").read_text() == _FIXABLE
+    assert "proposed (dry run)" in res.stdout
+    assert "+        with self._lock:" in res.stdout
+
+
+def test_lockfix_handles_serve_loop_locals(tmp_path):
+    """The function-local `# guarded-by: lock` vocabulary wraps with the
+    bare lock name, not `self.`."""
+    (tmp_path / "serveish.py").write_text(
+        "import threading\n"
+        "\n"
+        "\n"
+        "def serve_like(lock):\n"
+        "    state = {}  # guarded-by: lock\n"
+        "    with lock:\n"
+        "        state['a'] = 1\n"
+        "    state['b'] = 2\n"
+    )
+    res = _run_lockfix(tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    fixed = (tmp_path / "serveish.py").read_text()
+    assert "    with lock:\n        state['b'] = 2" in fixed
+    assert _pass_findings("lock", tmp_path) == []
+
+
+def test_lockfix_repo_mode_is_a_noop_on_a_clean_repo():
+    """The repo carries no findings, so --fix must change nothing (and
+    exit 0) — the tier-1-safe property."""
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "lockcheck", "--fix",
+         "--dry-run"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "0 finding(s) wrapped" in res.stdout
 
 
 # --------------------------------------------------------------------------
@@ -388,6 +506,72 @@ def test_guard_is_identity_when_disabled():
         assert sanitize.guard(obj, lock, "x") is obj
     finally:
         sanitize.force(None)
+
+
+def test_loop_thread_self_call_raises_race_error(sanitizer):
+    """ISSUE 12 carry-over: calling a blocking _LoopThread proxy FROM its
+    own loop thread is a guaranteed deadlock (the Future can never
+    resolve while its loop blocks on it) — refused outright."""
+    from bitcoin_miner_tpu.lsp.sync import _LoopThread
+
+    lt = _LoopThread("san-selfcall")
+    try:
+        box = {}
+
+        def from_loop():
+            try:
+                lt.call(lambda: None)
+            except BaseException as e:
+                return e
+            return None
+
+        box["err"] = lt.call(lambda: from_loop())
+        # from_loop ran ON the loop thread; its nested call() must raise.
+        assert isinstance(box["err"], sanitize.RaceError), box["err"]
+    finally:
+        lt.stop()
+
+
+def test_loop_thread_joins_lock_order_graph(sanitizer):
+    """The Future-spelled ABBA: a loop whose callback takes the event
+    lock, and a caller that blocks on the loop WHILE HOLDING that lock,
+    is a deadlock-in-waiting — the order graph catches it
+    deterministically, whichever side runs first."""
+    from bitcoin_miner_tpu.lsp.sync import _LoopThread
+
+    event = sanitize.TrackedLock("san.loop.event")
+    lt = _LoopThread("san-order")
+    try:
+        # Leg 1: a loop callback acquires the event lock -> loop->event.
+        def takes_event():
+            with event:
+                pass
+
+        lt.call(takes_event)
+        # Leg 2: blocking on the loop while holding the event lock adds
+        # event->loop, closing the cycle.
+        with pytest.raises(sanitize.LockOrderError):
+            with event:
+                lt.call(lambda: None)
+    finally:
+        lt.stop()
+
+
+def test_loop_thread_clean_order_is_silent(sanitizer):
+    """The repo's real discipline — locks taken outside loop waits, loop
+    callbacks lock-free — records edges but never a cycle."""
+    from bitcoin_miner_tpu.lsp.sync import _LoopThread
+
+    event = sanitize.TrackedLock("san.loop.clean")
+    lt = _LoopThread("san-clean")
+    try:
+        with event:
+            lt.call(lambda: None)  # event->loop only: fine
+        lt.call(lambda: None)
+        with event:
+            pass
+    finally:
+        lt.stop()
 
 
 def test_serve_loop_discipline_clean_under_monitor(sanitizer):

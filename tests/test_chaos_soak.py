@@ -939,3 +939,129 @@ def test_gateway_interval_store_chaos_soak_sanitized(tmp_path):
 
     cold = ColdStore(path=spans_path)
     assert len(cold) > 0
+
+
+# --------------------------------------------------------------------------
+# 6. Full-matrix BMT_SANITIZE=1 soak (ISSUE 12 carry-over satellite):
+#    gateway + federation + steal legs in one sanitized run, slow tier.
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_full_matrix_sanitized_soak_gateway_federation_steal(tmp_path):
+    """The whole thread weave under the race sanitizer in one slow run:
+    (A) a burst-lossy duplicate-heavy fleet through the full gateway
+    stack, (B) the federation resilience drills — replica serve loops,
+    ingest, forwarders and gossip daemons all sharing TrackedLocks, with
+    the LSP loop threads joined into the acquisition-order graph
+    (ISSUE 12) — and (C) a live-but-hung straggler whose tail the steal
+    scan re-dispatches.  Any off-lock access, lock-order inversion, or
+    loop-thread deadlock shape aborts the soak."""
+    from bitcoin_miner_tpu.federation import drill as fed_drill
+    from bitcoin_miner_tpu.gateway import Gateway, SpanStore
+    from bitcoin_miner_tpu.utils import sanitize
+
+    sanitize.force(True)
+    sanitize.reset_order_graph()
+    try:
+        # ---- leg A: gateway stack under seeded burst loss -------------
+        CHAOS.reset()
+        CHAOS.seed(47)
+        CHAOS.run(standard_scenarios()["burst-loss"], loop_every=2.0)
+        server = lsp.Server(0, PARAMS, label="server")
+        gw = Gateway(Scheduler(min_chunk=500), spans=SpanStore(), rate=None)
+        threading.Thread(
+            target=server_mod.serve, args=(server, gw),
+            kwargs={"tick_interval": 0.05}, daemon=True,
+        ).start()
+        for _ in range(2):
+            mc = lsp.Client("127.0.0.1", server.port, PARAMS)
+            threading.Thread(
+                target=miner_mod.run_miner,
+                args=(mc, miner_mod.make_search("cpu")), daemon=True,
+            ).start()
+        try:
+            jobs = [("mx-a", 0, 4000), ("mx-a", 0, 4000),
+                    ("mx-a", 1000, 3000), ("mx-b", 0, 3000)]
+            out = {}
+
+            def one(i):
+                data, lo, hi = jobs[i]
+                for _ in range(6):
+                    try:
+                        c = lsp.Client("127.0.0.1", server.port, PARAMS)
+                    except (lsp.LspError, OSError):
+                        continue
+                    try:
+                        got = client_mod.request_once(c, data, hi, lower=lo)
+                    finally:
+                        try:
+                            c.close()
+                        except lsp.LspError:
+                            pass
+                    if got is not None:
+                        out[i] = got
+                        return
+
+            threads = [
+                threading.Thread(target=one, args=(i,))
+                for i in range(len(jobs))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+                assert not t.is_alive(), "gateway leg client starved"
+            for i, (data, lo, hi) in enumerate(jobs):
+                assert out.get(i) == min_hash_range(data, lo, hi), f"job {i}"
+        finally:
+            CHAOS.reset()
+            server.close()
+
+        # ---- leg B: federation resilience drills, sanitized -----------
+        for name in ("shed-storm", "death-detect", "ack-retransmit",
+                     "drain-handoff"):
+            report = fed_drill.run_fed_drill(name, seed=47)
+            assert report["ok"], report
+
+        # ---- leg C: steal scan on a live-but-hung straggler -----------
+        CHAOS.seed(48)
+        CHAOS.run(standard_scenarios()["burst-loss"], loop_every=2.0)
+        steals0 = METRICS.get("sched.steals")
+        server = lsp.Server(0, PARAMS, label="server")
+        sched = Scheduler(
+            min_chunk=500, max_chunk=2000,
+            straggler_min_seconds=2.5,
+            steal_min_seconds=0.3, steal_min_samples=4,
+        )
+        threading.Thread(
+            target=server_mod.serve, args=(server, sched),
+            kwargs={"tick_interval": 0.1}, daemon=True,
+        ).start()
+        wedged_once = threading.Event()
+
+        def slow_search(d, lo, hi):
+            if not wedged_once.is_set():
+                wedged_once.set()
+                time.sleep(8.0)
+            return min_hash_range(d, lo, hi)
+
+        for i, fn in enumerate([slow_search, min_hash_range, min_hash_range]):
+            mc = lsp.Client("127.0.0.1", server.port, PARAMS, label=f"m{i}")
+            threading.Thread(
+                target=miner_mod.run_miner, args=(mc, fn), daemon=True
+            ).start()
+        try:
+            c = lsp.Client("127.0.0.1", server.port, PARAMS)
+            try:
+                got = client_mod.request_once(c, "mx-steal", 20_000)
+            finally:
+                c.close()
+            assert got == min_hash_range("mx-steal", 0, 20_000)
+            assert METRICS.get("sched.steals") > steals0
+        finally:
+            CHAOS.reset()
+            server.close()
+    finally:
+        sanitize.force(None)
+        sanitize.reset_order_graph()

@@ -29,12 +29,23 @@ from bitcoin_miner_tpu.apps.scheduler import Scheduler
 from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
 from bitcoin_miner_tpu.federation import (
     GossipSpanStore,
+    Membership,
     Replica,
     Ring,
+    decode_fed,
     decode_gossip,
     encode_gossip,
+    encode_handoff,
 )
-from bitcoin_miner_tpu.federation.gossip import apply_gossip
+from bitcoin_miner_tpu.federation import drill as fed_drill
+from bitcoin_miner_tpu.federation.gossip import SpanGossip, apply_gossip
+from bitcoin_miner_tpu.federation.membership import (
+    ALIVE,
+    DEAD,
+    LOAD_DRAINING,
+    LOAD_SHEDDING,
+    SUSPECT,
+)
 from bitcoin_miner_tpu.lspnet.chaos import CHAOS
 from bitcoin_miner_tpu.utils.metrics import METRICS
 from bitcoin_miner_tpu.utils.telemetry import FrameAssembler
@@ -190,6 +201,369 @@ class TestGossipStore:
         store.add_remote("b", 200, 299, 40, 250)
         exported = sorted(store.export_spans())
         assert exported == [("a", 0, 99, 50, 10), ("b", 200, 299, 40, 250)]
+
+
+# -------------------------------------------- membership plane (ISSUE 12)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestMembership:
+    """The suspicion-based failure detector, pure-unit on a fake clock."""
+
+    def _m(self, **kw):
+        clk = FakeClock()
+        m = Membership(
+            "r0", ["r1", "r2"], interval=1.0,
+            suspect_misses=3, confirm_misses=3, clock=clk, **kw,
+        )
+        return m, clk
+
+    def test_silence_suspects_then_confirms_dead(self):
+        METRICS.reset()
+        m, clk = self._m()
+        m.heard("r1", "OK", 7)
+        clk.t = 2.0
+        m.tick()
+        assert m.liveness("r1") == ALIVE  # inside the suspect window
+        clk.t = 3.5
+        m.tick()
+        assert m.liveness("r1") == SUSPECT  # miss-count tripped
+        assert METRICS.get("fed.suspected") >= 1
+        clk.t = 5.0
+        m.heard("r2", "OK", 1)  # r2 keeps beating: only r1 is silent
+        m.tick()
+        assert m.liveness("r1") == SUSPECT  # confirmation window holds
+        clk.t = 7.0
+        m.heard("r2", "OK", 1)
+        m.tick()
+        assert m.liveness("r1") == DEAD  # confirmed
+        assert "r1" not in m.routable()
+        assert "r2" in m.routable() and "r0" in m.routable()
+
+    def test_suspect_that_beats_again_is_a_false_suspicion(self):
+        METRICS.reset()
+        m, clk = self._m()
+        m.heard("r1", "OK", 7)
+        clk.t = 4.0
+        m.tick()
+        assert m.liveness("r1") == SUSPECT
+        m.heard("r1", "OK", 7)
+        assert m.liveness("r1") == ALIVE
+        assert METRICS.get("fed.false_suspicions") == 1
+
+    def test_shedding_peer_is_deprioritized_never_dead(self):
+        METRICS.reset()
+        m, clk = self._m()
+        m.heard("r1", LOAD_SHEDDING, 1)
+        m.heard("r2", "OK", 1)
+        # A SHEDDING peer keeps beating: never suspected however long.
+        for t in (1.0, 2.0, 3.0, 4.0):
+            clk.t = t
+            m.heard("r1", LOAD_SHEDDING, 1)
+            m.heard("r2", "OK", 1)
+            m.tick()
+        assert m.liveness("r1") == ALIVE
+        assert METRICS.get("fed.false_suspicions") == 0
+        # Ring order r1-first gets re-ranked: OK peer ahead of SHEDDING.
+        assert m.order(["r1", "r2"]) == ["r2", "r1"]
+
+    def test_draining_peer_gets_no_new_forwards(self):
+        m, clk = self._m()
+        m.heard("r1", LOAD_DRAINING, 1)
+        m.heard("r2", "OK", 1)
+        assert m.order(["r1", "r2"]) == ["r2"]
+        assert m.liveness("r1") == ALIVE  # draining is alive, just closed
+
+    def test_restart_detection_via_incarnation(self):
+        m, clk = self._m()
+        assert m.heard("r1", "OK", 100) is False  # first contact
+        assert m.heard("r1", "OK", 100) is False  # same life
+        assert m.heard("r1", "OK", 101) is True  # restarted
+        assert m.heard("r1", "OK", 100) is False  # stale heartbeat: no reset
+
+    def test_fresh_requires_a_recent_heartbeat(self):
+        m, clk = self._m()
+        assert not m.fresh("r1")  # never heard: grace is not proof
+        m.heard("r1", "OK", 1)
+        assert m.fresh("r1")
+        clk.t = 10.0
+        assert not m.fresh("r1")  # silent too long
+
+
+class TestGossipAcks:
+    """Per-peer acked-delta retention (ISSUE 12), store-level."""
+
+    def test_pending_retained_until_acked(self):
+        store = GossipSpanStore()
+        store.add("a", 0, 99, 50, 10)
+        store.add("b", 0, 99, 60, 20)
+        p1 = store.pending_for("peer")
+        assert [s for _, s in p1] == [
+            ("a", 0, 99, 50, 10), ("b", 0, 99, 60, 20),
+        ]
+        # Unacked: a second beat resends the SAME entries.
+        assert store.pending_for("peer") == p1
+        store.record_ack("peer", p1[0][0])  # first entry acked
+        assert [s for _, s in store.pending_for("peer")] == [
+            ("b", 0, 99, 60, 20),
+        ]
+        store.record_ack("peer", p1[1][0])
+        assert store.pending_for("peer") == []
+
+    def test_ack_floor_prunes_only_when_every_peer_acked(self):
+        store = GossipSpanStore()
+        store.set_peers(["p1", "p2"])
+        store.add("a", 0, 99, 50, 10)
+        seq = store.jseq()
+        store.record_ack("p1", seq)
+        # p1's ack must NOT prune what p2 (which never acked) is owed.
+        assert store.pending_for("p2") != []
+        assert len(store._journal) == 1
+        store.record_ack("p2", seq)
+        assert store.pending_for("p1") == [] and store.pending_for("p2") == []
+        assert len(store._journal) == 0  # everyone acked: pruned
+
+    def test_journal_overflow_escalates_lagging_peer_to_full_sync(self):
+        store = GossipSpanStore(journal_max=4)
+        for i in range(10):
+            store.add(f"d{i}", 0, 9, 5, 3)
+        # A peer that acked nothing can no longer be served by deltas.
+        assert store.needs_full("laggard")
+        # A peer past the dropped high-water can.
+        store.record_ack("fresh", store.jseq())
+        assert not store.needs_full("fresh")
+
+    def test_restart_reset_voids_acks_and_seen(self):
+        store = GossipSpanStore()
+        store.add("a", 0, 99, 50, 10)
+        store.record_ack("peer", store.jseq())
+        store.record_seen("peer", 17)
+        store.reset_peer("peer")
+        assert store.seen_seq("peer") == 0
+        assert store.pending_for("peer") != []  # retained entries resend
+
+    def test_beat_counts_retransmits_and_standalone_heartbeats(self, monkeypatch):
+        """Daemon-level unit: a delta sent once and unacked past the ack
+        grace window (one reverse-beat round trip) is resent and counted
+        as a retransmit; inside the window it is NOT (ordinary ack
+        latency must not read as loss); a beat with nothing to ship
+        still sends (the standalone heartbeat)."""
+        METRICS.reset()
+        store = GossipSpanStore()
+        sent = []
+        gossip = SpanGossip(
+            "a", store, {"b": ("127.0.0.1", 1)}, threading.Lock(),
+            full_every=10**9, hb_fn=lambda: {"inc": 1, "load": "OK"},
+        )
+        monkeypatch.setattr(gossip, "_send", lambda name, frames: (
+            sent.append((name, list(frames))) or True
+        ))
+        gossip.beat()  # nothing journaled: heartbeat-only beat still sent
+        assert len(sent) == 1
+        store.add("a", 0, 99, 50, 10)
+        gossip.beat()  # first send of the delta: not a retransmit
+        assert METRICS.get("gossip.retransmits") == 0
+        gossip.beat()  # inside the grace window: no resend, no count
+        assert METRICS.get("gossip.retransmits") == 0
+        gossip.beat()  # grace expired, still unacked -> retransmit
+        assert METRICS.get("gossip.retransmits") == 1
+        store.record_ack("b", store.jseq())
+        gossip.beat()  # acked: nothing pending, heartbeat-only again
+        assert METRICS.get("gossip.retransmits") == 1
+        assert METRICS.get("federation.gossip_full_syncs") == 0
+        assert len(sent) == 5
+
+    def test_conn_death_resends_in_flight_tail_on_fresh_conn(self, monkeypatch):
+        """The cumulative high-water ack is only sound over contiguous
+        in-order delivery: when a send fails (conn died, in-flight tail
+        lost), the next beat must resend EVERYTHING unacked immediately
+        — no grace — or a later fresh-only delta would ack over the
+        hole."""
+        METRICS.reset()
+        store = GossipSpanStore()
+        ok = {"v": True}
+        shipped = []
+        gossip = SpanGossip(
+            "a", store, {"b": ("127.0.0.1", 1)}, threading.Lock(),
+            full_every=10**9,
+        )
+
+        def fake_send(name, frames):
+            if ok["v"]:
+                shipped.append(list(frames))
+            return ok["v"]
+
+        monkeypatch.setattr(gossip, "_send", fake_send)
+        store.add("a", 0, 99, 50, 10)
+        gossip.beat()  # delta on the wire (conn 1)
+        ok["v"] = False
+        store.add("b", 0, 99, 60, 20)
+        gossip.beat()  # conn died mid-flight: send fails
+        ok["v"] = True
+        n0 = len(shipped)
+        gossip.beat()  # fresh conn: BOTH unacked entries resent at once
+        assert len(shipped) == n0 + 1
+        asm = FrameAssembler()
+        done, obj = [asm.feed(f) for f in shipped[-1]][-1]
+        assert done
+        msg = decode_gossip(obj)
+        datas = {row[0] for row in msg["spans"]}
+        assert datas == {"a", "b"}
+        assert METRICS.get("gossip.retransmits") >= 1  # "a" went out before
+
+    def test_stop_voids_send_windows_so_drain_flush_resends(self, monkeypatch):
+        """Regression: stop() closes the conns (in-flight tails lost), so
+        the drain path's final beat must resend every unacked entry —
+        the ack grace window must not filter away spans shipped just
+        before the stop, or the promised drain flush ships a heartbeat
+        and nothing else."""
+        store = GossipSpanStore()
+        shipped = []
+        gossip = SpanGossip(
+            "a", store, {"b": ("127.0.0.1", 1)}, threading.Lock(),
+            full_every=10**9,
+        )
+        monkeypatch.setattr(gossip, "_send", lambda name, frames: (
+            shipped.append(list(frames)) or True
+        ))
+        store.add("a", 0, 99, 50, 10)
+        gossip.beat()  # shipped once, unacked, grace window armed
+        gossip.stop()  # drain: conns (and any in-flight tail) are gone
+        gossip.beat()  # the drain flush
+        asm = FrameAssembler()
+        done, obj = [asm.feed(f) for f in shipped[-1]][-1]
+        assert done
+        msg = decode_gossip(obj)
+        assert [tuple(s) for s in msg["spans"]] == [("a", 0, 99, 50, 10)]
+
+    def test_handoff_codec_roundtrip(self):
+        state = {"version": 1, "workload": "sha256d",
+                 "jobs": [{"data": "x", "lower": 0, "upper": 99,
+                           "best": [5, 7], "remaining": [[10, 99]]}]}
+        frames = encode_handoff("r1", 3, state)
+        asm = FrameAssembler()
+        done, obj = [asm.feed(f) for f in frames][-1]
+        assert done
+        msg = decode_fed(obj)
+        assert msg is not None and msg["kind"] == "handoff"
+        assert msg["from"] == "r1" and msg["state"] == state
+        # decode_gossip (the spans-only gate) refuses a handoff.
+        assert decode_gossip(obj) is None
+
+
+class TestOrphanHandoff:
+    """Scheduler.export_orphans / import_orphans (ISSUE 12)."""
+
+    def test_roundtrip_resumes_stashed_progress(self):
+        a = Scheduler(min_chunk=100, max_chunk=100, validate_results=False)
+        a.miner_joined(1)
+        a.client_request(10, "hand", 0, 999)
+        a.result(1, hash_=500, nonce=50)  # one chunk done
+        a.lost(10)  # client died: progress stashed
+        b = Scheduler(min_chunk=10**6)
+        assert b.import_orphans(a.export_orphans()) >= 1
+        b.miner_joined(2)
+        acts = b.client_request(11, "hand", 0, 999)
+        # The resumed job sweeps only the remaining 900 nonces.
+        reqs = [m for _, m in acts if m.type.name == "REQUEST"]
+        assert reqs and reqs[0].lower == 100 and reqs[0].upper == 999
+
+    def test_import_validates_rows_and_refuses_foreign_workload(self):
+        b = Scheduler()
+        good = {"version": 1, "workload": "sha256d", "jobs": [
+            {"data": "ok", "lower": 0, "upper": 9, "best": [5, 3],
+             "remaining": [[4, 9]]},
+            {"data": 123, "lower": 0, "upper": 9, "best": None,
+             "remaining": [[0, 9]]},  # bad data type: skipped
+            {"data": "bad-best", "lower": 0, "upper": 9, "best": [1],
+             "remaining": [[0, 9]]},  # malformed best: skipped
+        ]}
+        assert b.import_orphans(good) == 1
+        foreign = {"version": 2, "workload": "blake2b64",
+                   "state": {"jobs": [{"data": "x", "lower": 0, "upper": 9,
+                                       "best": [1, 2], "remaining": []}]}}
+        assert b.import_orphans(foreign) == 0  # another hash family: refused
+
+    def test_import_respects_orphan_bound(self):
+        b = Scheduler(orphan_cache_max=2)
+        state = {"version": 1, "workload": "sha256d", "jobs": [
+            {"data": f"k{i}", "lower": 0, "upper": 9, "best": [i, 0],
+             "remaining": [[0, 9]]}
+            for i in range(5)
+        ]}
+        assert b.import_orphans(state) == 5
+        assert len(b._resume) == 2  # bounded, oldest evicted
+
+
+class TestRingSuccessor:
+    def test_deterministic_and_distinct(self):
+        ring = Ring(["r0", "r1", "r2", "r3"])
+        for name in ring.names:
+            succ = ring.successor(name)
+            assert succ is not None and succ != name
+            assert succ == Ring(["r3", "r2", "r1", "r0"]).successor(name)
+
+    def test_alive_filter_and_degenerate_ring(self):
+        ring = Ring(["r0", "r1", "r2"])
+        succ = ring.successor("r0")
+        alive = [n for n in ring.names if n != succ and n != "r0"]
+        assert ring.successor("r0", alive=alive) == alive[0]
+        assert Ring(["solo"]).successor("solo") is None
+        assert ring.successor("r0", alive=[]) is None
+
+
+# ---------------------------------------- resilience drills (ISSUE 12 e2e)
+
+
+def test_shed_vs_death_discrimination_drill():
+    """The ISSUE 12 shed-vs-death acceptance: a peer forced into
+    SHEDDING via admission flood stays routable and is never suspected
+    or marked down (fed.false_suspicions == 0)."""
+    METRICS.reset()
+    report = fed_drill.drill_shed_storm(seed=1)
+    assert report["ok"], report
+    assert report["false_suspicions"] == 0
+    assert report["liveness_during_storm"] == ALIVE
+    assert not report["marked_down"] and report["still_routable"]
+
+
+def test_death_detected_by_heartbeats_within_confirmation_window():
+    """A SIGKILL-shaped death is suspected then declared dead by missed
+    heartbeats alone — zero forward-path connect timeouts spent."""
+    METRICS.reset()
+    report = fed_drill.drill_death_detect(seed=1)
+    assert report["ok"], report
+    assert report["suspected"] >= 1 and report["declared_dead"]
+    assert report["forward_timeouts"] == 0
+    assert report["forward_failovers"] == 0
+
+
+def test_ack_gap_retransmit_converges_without_full_sync():
+    """Lost deltas recovered by ack-gap retransmit with anti-entropy
+    disabled (full_every=10**9) — the full sync can no longer mask a
+    broken delta path."""
+    METRICS.reset()
+    report = fed_drill.drill_ack_retransmit(seed=1)
+    assert report["ok"], report
+    assert report["retransmits"] >= 1 and report["full_syncs"] == 0
+
+
+def test_drain_handoff_successor_resumes_from_stash():
+    """The ISSUE 12 drain acceptance: a cell drained mid-sweep hands its
+    stash to the ring successor; the resubmitted job answers bit-exact
+    with STRICTLY fewer nonces swept than a from-scratch control."""
+    METRICS.reset()
+    report = fed_drill.drill_drain_handoff(seed=1)
+    assert report["ok"], report
+    assert report["bit_exact"] and report["handoff_jobs"] >= 1
+    assert report["resumed_nonces_swept"] < report["scratch_nonces_swept"]
 
 
 # -------------------------------------------------------------- replica e2e

@@ -822,6 +822,65 @@ class TestSpeculativePrefill:
         assert keys(g.sched.checkpoint()) == {("other-data", 0, 99)}
 
 
+class TestHotnessDecay:
+    """Recency-weighted prefill hotness (ISSUE 12 satellite): cover-hit
+    scores decay with a half-life, so a formerly-hot key stops hogging
+    idle prefill capacity and a newly-hot key overtakes it."""
+
+    def _store(self, half_life=10.0):
+        clock = {"t": 0.0}
+        store = SpanStore(hot_half_life_s=half_life,
+                          clock=lambda: clock["t"])
+        return store, clock
+
+    def test_cold_key_overtakes_formerly_hot_key(self):
+        store, clock = self._store()
+        # OLD gets very hot at t=0: solved spans with an internal gap
+        # (so prefill_target has a gap to offer) + 5 cover hits.
+        store.add("old", 0, 99, 700, 10)
+        store.add("old", 200, 299, 650, 250)
+        for _ in range(5):
+            store.cover("old", 0, 50)
+        assert store.prefill_target(50)[0] == "old"
+        # Ten half-lives later, NEW gets a single hit.
+        clock["t"] = 100.0
+        store.add("new", 0, 99, 500, 5)
+        store.add("new", 200, 299, 450, 250)
+        store.cover("new", 0, 50)
+        # 5 * 2^-10 ≈ 0.005 < 1.0: the cold key overtakes — and OLD has
+        # decayed below the floor entirely, so it no longer competes.
+        target = store.prefill_target(50)
+        assert target is not None and target[0] == "new"
+
+    def test_decayed_cold_key_stops_hogging_idle_capacity(self):
+        store, clock = self._store()
+        store.add("old", 0, 99, 700, 10)
+        store.add("old", 200, 299, 650, 250)
+        store.cover("old", 0, 50)
+        assert store.prefill_target(50) is not None
+        clock["t"] = 50.0  # five half-lives: score ~0.03 < HOT_MIN
+        assert store.prefill_target(50) is None
+
+    def test_fresh_hits_rebuild_hotness(self):
+        store, clock = self._store()
+        store.add("d", 0, 99, 700, 10)
+        store.add("d", 200, 299, 650, 250)
+        store.cover("d", 0, 50)
+        clock["t"] = 50.0
+        assert store.prefill_target(50) is None  # decayed out
+        store.cover("d", 0, 50)  # reused again: hot again
+        assert store.prefill_target(50) is not None
+
+    def test_half_life_none_disables_decay(self):
+        clock = {"t": 0.0}
+        store = SpanStore(hot_half_life_s=None, clock=lambda: clock["t"])
+        store.add("d", 0, 99, 700, 10)
+        store.add("d", 200, 299, 650, 250)
+        store.cover("d", 0, 50)
+        clock["t"] = 1e9
+        assert store.prefill_target(50) is not None  # legacy behavior
+
+
 class TestAdmission:
     def test_max_active_queues_then_admits_on_completion(self):
         g = make_gateway(max_active=1)

@@ -35,6 +35,25 @@ DEFAULT_RATCHET = Path(__file__).resolve().parent / "ratchet.json"
 def main(argv: List[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="python -m tools.analyze")
     ap.add_argument(
+        "mode",
+        nargs="?",
+        default=None,
+        choices=["lockcheck"],
+        help="subcommand: `lockcheck --fix` mechanically wraps safe "
+        "unguarded accesses in `with <lock>:` and prints annotated "
+        "diffs for the rest (ISSUE 12 carry-over)",
+    )
+    ap.add_argument(
+        "--fix",
+        action="store_true",
+        help="with `lockcheck`: rewrite safe findings in place",
+    )
+    ap.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="with `lockcheck --fix`: print the would-be diffs, touch nothing",
+    )
+    ap.add_argument(
         "--pass",
         dest="passes",
         default="all",
@@ -60,6 +79,25 @@ def main(argv: List[str] | None = None) -> int:
     )
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.mode == "lockcheck":
+        if not args.fix:
+            ap.error("lockcheck mode needs --fix (plain checking is "
+                     "`--pass lock`)")
+        from .lockfix import fix as lockfix_fix
+
+        repo_mode = args.root is None
+        root = REPO_ROOT if repo_mode else Path(args.root).resolve()
+        scan = DEFAULT_SCAN_DIRS if repo_mode else None
+        fixed, reviews = lockfix_fix(root, scan, write=not args.dry_run)
+        for entry in reviews:
+            print(entry)
+        if not args.quiet:
+            print(
+                f"tools.analyze lockcheck --fix: {fixed} finding(s) "
+                f"wrapped, {len(reviews)} left for review"
+            )
+        return 1 if reviews else 0
 
     names = (
         list(PASSES) if args.passes == "all" else [p.strip() for p in args.passes.split(",")]

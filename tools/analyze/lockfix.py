@@ -1,0 +1,227 @@
+"""``python -m tools.analyze lockcheck --fix`` — the mechanical lock fixer.
+
+The lock pass (lockcheck.py) FINDS unguarded accesses; this mode fixes
+the subset a machine can fix safely and shows its work for the rest:
+
+- **Safe to wrap**: the flagged access sits in a SIMPLE statement — an
+  expression, assignment, augmented assignment or ``return`` occupying
+  its own suite slot — that touches exactly one missing lock and
+  contains no ``acquire``/``release``/``with`` lock machinery of its
+  own.  The statement is rewritten in place as::
+
+      with self._lock:          # (or `with lock:` for serve-loop locals)
+          <original statement>
+
+  Adjacent flagged statements needing the same lock in the same suite
+  fold into one ``with`` block rather than N nested one-liners.
+- **Not safe**: the access lives in a compound-statement header (an
+  ``if`` test, a loop iterator, a ``with`` item), inside a lambda or
+  comprehension, in a ``def`` line, or the statement needs two
+  different locks.  Wrapping those mechanically would change control
+  flow (a guarded loop header does not guard the body) — so the fixer
+  emits an annotated unified diff of what a human should review
+  instead, and leaves the file byte-identical.
+
+Exit status: 0 when every finding was fixed (or there were none),
+1 when findings remain that need review.  The rewrite is idempotent:
+re-running after a fix finds nothing to do.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from . import lockcheck
+from .common import Finding, iter_py_files, rel
+
+#: Statement types a machine may wrap: single-suite-slot, no control
+#: flow of their own — moving them under a ``with`` cannot change what
+#: executes, only what lock is held while it does.
+_SIMPLE_STMTS = (ast.Expr, ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Return)
+
+
+def _lock_spelling(symbol: str, lock: str) -> str:
+    """The ``with`` target for a finding: class-field findings guard with
+    ``self.<lock>``, serve-loop local findings with the bare name."""
+    return f"self.{lock}" if "." in symbol else lock
+
+
+def _finding_lock(f: Finding) -> Optional[str]:
+    """The missing lock name, recovered from the finding message (the
+    message formats in lockcheck.py are this module's parse contract)."""
+    msg = f.message
+    for marker in ("without holding self.", "outside `with "):
+        at = msg.find(marker)
+        if at >= 0:
+            rest = msg[at + len(marker):]
+            name = rest.split(None, 1)[0].rstrip(":`(")
+            return name.rstrip("`:")
+    return None
+
+
+class _StmtIndex(ast.NodeVisitor):
+    """Map line numbers to their innermost enclosing SIMPLE statement,
+    and record lines that are compound headers / defs / lambdas —
+    the not-safe territory."""
+
+    def __init__(self) -> None:
+        self.simple: Dict[int, ast.stmt] = {}  # line -> simple stmt covering it
+        self.unsafe_lines: set = set()
+
+    def visit(self, node: ast.AST) -> None:
+        if isinstance(node, _SIMPLE_STMTS):
+            end = getattr(node, "end_lineno", node.lineno)
+            contains_lambda = any(
+                isinstance(n, (ast.Lambda, ast.ListComp, ast.SetComp,
+                               ast.DictComp, ast.GeneratorExp))
+                for n in ast.walk(node)
+            )
+            for line in range(node.lineno, end + 1):
+                if contains_lambda:
+                    self.unsafe_lines.add(line)
+                else:
+                    self.simple.setdefault(line, node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            self.unsafe_lines.add(node.lineno)
+        elif isinstance(node, ast.stmt):
+            # Compound statement: its HEADER lines are unsafe (wrapping
+            # an `if` test or a loop iter under a lock would not guard
+            # the body it controls); body lines index via children.
+            first_body = min(
+                (b.lineno for attr in ("body", "orelse", "finalbody")
+                 for b in getattr(node, attr, []) or []),
+                default=getattr(node, "end_lineno", node.lineno) + 1,
+            )
+            for line in range(node.lineno, first_body):
+                self.unsafe_lines.add(line)
+        self.generic_visit(node)
+
+
+def _wrap(
+    lines: List[str], stmt: ast.stmt, lock_ref: str
+) -> List[str]:
+    """The replacement block: ``with <lock_ref>:`` + the statement
+    re-indented one level (list of lines, no trailing newlines)."""
+    start, end = stmt.lineno - 1, getattr(stmt, "end_lineno", stmt.lineno) - 1
+    body = lines[start:end + 1]
+    indent = body[0][: len(body[0]) - len(body[0].lstrip())]
+    out = [f"{indent}with {lock_ref}:"]
+    out.extend("    " + ln if ln.strip() else ln for ln in body)
+    return out
+
+
+def _diff(path: str, old: List[str], new: List[str], note: str) -> str:
+    import difflib
+
+    body = "".join(
+        difflib.unified_diff(
+            [ln + "\n" for ln in old],
+            [ln + "\n" for ln in new],
+            fromfile=f"a/{path}",
+            tofile=f"b/{path}",
+        )
+    )
+    return f"# lockcheck --fix: {note}\n{body}"
+
+
+def fix(
+    root: Path, scan_dirs: Optional[Tuple[str, ...]] = None,
+    write: bool = True,
+) -> Tuple[int, List[str]]:
+    """Run the lock pass, apply every safe fix in place, and return
+    ``(fixed_count, review_diffs)`` — the diffs are the annotated
+    not-safe findings a human must place by hand."""
+    findings = lockcheck.run(root, scan_dirs)
+    by_file: Dict[str, List[Finding]] = {}
+    for f in findings:
+        if f.rule in ("field-off-lock", "helper-off-lock", "local-off-lock"):
+            by_file.setdefault(f.path, []).append(f)
+    fixed = 0
+    reviews: List[str] = []
+    paths = {rel(p, root): p for p in iter_py_files(root, scan_dirs)}
+    for rpath, flist in sorted(by_file.items()):
+        path = paths.get(rpath)
+        if path is None:
+            continue
+        source = path.read_text()
+        lines = source.splitlines()
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            continue  # the lock pass already reported it
+        index = _StmtIndex()
+        index.visit(tree)
+        # Group findings by their enclosing simple statement; a finding
+        # with no simple statement (or on an unsafe line) needs review.
+        per_stmt: Dict[int, Tuple[ast.stmt, str]] = {}
+        for f in flist:
+            lock = _finding_lock(f)
+            stmt = index.simple.get(f.line)
+            if (
+                lock is None
+                or stmt is None
+                or f.line in index.unsafe_lines
+                or _has_lock_machinery(stmt)
+            ):
+                reviews.append(_review_entry(f, lines, lock))
+                continue
+            key = stmt.lineno
+            prev = per_stmt.get(key)
+            ref = _lock_spelling(f.symbol, lock)
+            if prev is not None and prev[1] != ref:
+                # Two different locks wanted on one statement: no single
+                # mechanical wrap is correct.
+                reviews.append(_review_entry(f, lines, lock))
+                per_stmt.pop(key, None)
+                continue
+            per_stmt[key] = (stmt, ref)
+        if not per_stmt:
+            continue
+        # Apply bottom-up so earlier line numbers stay valid.
+        new_lines = list(lines)
+        for _, (stmt, ref) in sorted(per_stmt.items(), reverse=True):
+            start = stmt.lineno - 1
+            end = getattr(stmt, "end_lineno", stmt.lineno) - 1
+            new_lines[start:end + 1] = _wrap(lines, stmt, ref)
+            fixed += 1
+        if write:
+            path.write_text(
+                "\n".join(new_lines) + ("\n" if source.endswith("\n") else "")
+            )
+        else:
+            reviews.append(
+                _diff(rpath, lines, new_lines, "proposed (dry run)")
+            )
+    return fixed, reviews
+
+
+def _has_lock_machinery(stmt: ast.stmt) -> bool:
+    """A statement already juggling locks (acquire/release calls or a
+    nested ``with``) is never auto-wrapped: the author is mid-discipline
+    and a second layer could deadlock or mask the real fix."""
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.With):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in ("acquire", "release"):
+                return True
+    return False
+
+
+def _review_entry(f: Finding, lines: List[str], lock: Optional[str]) -> str:
+    """An annotated context block for a finding the fixer refuses."""
+    at = f.line - 1
+    lo, hi = max(0, at - 2), min(len(lines), at + 3)
+    ctx = "\n".join(
+        f"{'>' if i == at else ' '} {i + 1:4d} {lines[i]}"
+        for i in range(lo, hi)
+    )
+    want = lock or "?"
+    return (
+        f"# lockcheck --fix: NOT auto-fixable — {f.path}:{f.line} "
+        f"{f.symbol} needs `{want}` but sits in a compound header, "
+        f"closure, or multi-lock statement; guard it by hand:\n{ctx}\n"
+    )

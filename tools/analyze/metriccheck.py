@@ -62,7 +62,9 @@ _METRICS_ASSIGN = re.compile(r"^METRICS\s*=", re.MULTILINE)
 def _name_kind(name: str) -> str:
     if name.startswith("hist."):
         return "hist"
-    if name.startswith(("gauge.", "fleet.")):
+    if name.startswith(("gauge.", "fleet.", "fed.peer_state")):
+        # fed.peer_state[.<peer>] is the per-peer membership gauge family
+        # (ISSUE 12); the rest of fed.* stays counter-kind.
         return "gauge"
     return "counter"
 

@@ -38,6 +38,12 @@ def main(argv=None) -> int:
                         help="kill miner-0's conn this many seconds in")
     parser.add_argument("--epoch-millis", type=int, default=100)
     parser.add_argument("--timeout", type=float, default=120.0)
+    parser.add_argument("--fed-drill", metavar="NAME", default=None,
+                        help="replay a federation resilience drill "
+                             "(ISSUE 12) instead of a chaos-soak scenario: "
+                             "shed-storm, drain-handoff, death-detect, "
+                             "ack-retransmit — same seeded decisions as "
+                             "the failing fleet_bench --federation leg")
     parser.add_argument("--list", action="store_true",
                         help="list scenario names and exit")
     parser.add_argument("--trace", metavar="FILE", default=None,
@@ -52,11 +58,34 @@ def main(argv=None) -> int:
     from bitcoin_miner_tpu.apps.drill import run_drill
 
     if args.list:
+        from bitcoin_miner_tpu.federation.drill import DRILLS
+
         for name, sched in lspnet.standard_scenarios().items():
             print(f"{name:24s} {sched.desc}")
+        for name in DRILLS:
+            print(f"{name:24s} federation resilience drill (--fed-drill)")
         return 0
     if args.verbose:
         lspnet.enable_debug_logs(True)
+    if args.fed_drill:
+        from bitcoin_miner_tpu.federation.drill import run_fed_drill
+
+        if args.trace:
+            from bitcoin_miner_tpu.utils.trace import TRACE
+
+            TRACE.enable(path=args.trace)
+        try:
+            report = run_fed_drill(args.fed_drill, seed=args.seed)
+        except ValueError as e:
+            print(f"chaos_replay: {e}", file=sys.stderr)
+            return 2
+        finally:
+            if args.trace:
+                from bitcoin_miner_tpu.utils.trace import TRACE
+
+                TRACE.disable()
+        print(json.dumps(report))
+        return 0 if report.get("ok") else 1
     try:
         report = run_drill(
             args.scenario,

@@ -41,7 +41,11 @@ from bitcoin_miner_tpu.utils.metrics import format_quantiles  # noqa: E402
 
 #: Counters worth a dashboard row even when many exist (prefix order =
 #: display order); everything else folds into the "other" count.
-_COUNTER_PREFIXES = ("sched.", "gateway.", "miner.", "telemetry.", "slo.")
+_COUNTER_PREFIXES = ("sched.", "gateway.", "miner.", "telemetry.", "slo.",
+                     "federation.", "fed.", "gossip.")
+
+#: fed.peer_state gauge codes (ISSUE 12) rendered human-readable.
+_PEER_STATES = ("OK", "SHEDDING", "DRAINING", "SUSPECT", "DEAD")
 
 
 def _fmt_age(age_s: float) -> str:
@@ -74,6 +78,22 @@ def render_frame(state: dict, width: int = 78) -> str:
             lines.append(
                 f"  {s['name']:<20} {s['burn_fast']:>8.2f}/{s['burn_slow']:<8.2f} {mark}"
             )
+    peer_states = {
+        k[len("fed.peer_state."):]: v
+        for k, v in (state.get("gauges") or {}).items()
+        if k.startswith("fed.peer_state.")
+    }
+    if peer_states:
+        lines.append(bar)
+        lines.append("federation peers (membership):")
+        for name in sorted(peer_states):
+            code = int(peer_states[name])
+            label = (
+                _PEER_STATES[code]
+                if 0 <= code < len(_PEER_STATES)
+                else f"?{code}"
+            )
+            lines.append(f"  {name:<28} {label}")
     strag = state.get("stragglers")
     if strag:
         lines.append(bar)
@@ -120,6 +140,7 @@ def merge_cell_states(cells: dict) -> dict:
         "stale_sources": 0,
         "per_source": {},
         "counters": {},
+        "gauges": {},
         "hists": {},
         "stragglers": [],
     }
@@ -135,6 +156,13 @@ def merge_cell_states(cells: dict) -> dict:
         for k, v in (state.get("counters") or {}).items():
             if isinstance(v, (int, float)):
                 out["counters"][k] = out["counters"].get(k, 0) + v
+        for k, v in (state.get("gauges") or {}).items():
+            if k.startswith("fed.peer_state."):
+                # Each cell's view of ITS peers: keep per-cell resolution
+                # (`a` seeing `b` DEAD while `b` sees itself fine is
+                # exactly the asymmetry worth showing).
+                peer = k[len("fed.peer_state."):]
+                out["gauges"][f"fed.peer_state.{cell}->{peer}"] = v
         for k, s in (state.get("hists") or {}).items():
             out["hists"][f"{cell}/{k}"] = s
         for s in state.get("stragglers") or []:

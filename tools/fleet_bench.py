@@ -412,9 +412,30 @@ def run_federation_bench(args) -> int:
     Prints one JSON line."""
     import random
 
+    from bitcoin_miner_tpu.federation.drill import run_all as run_fed_drills
     from bitcoin_miner_tpu.federation.ring import Ring
 
     min_hash_range = WORKLOAD.min_range
+
+    # Resilience legs first (ISSUE 12), in-process so counters are
+    # readable: shed-storm (zero false-death markings), drain-handoff
+    # (successor resumes from stashed progress, strictly fewer nonces
+    # swept than from-scratch), death-detect (SIGKILL-shaped silence
+    # declared dead with no forward-path connect timeout spent), and
+    # ack-retransmit (partition heals via ack-gap retransmit before any
+    # full sync).  Each failing drill replays from its seed with
+    # `python tools/chaos_replay.py --fed-drill NAME --seed N`.
+    resilience = run_fed_drills(seed=args.chaos_seed)
+    for rep in resilience:
+        log(f"resilience drill {rep['name']}: "
+            f"{'ok' if rep['ok'] else 'FAILED'} {rep}")
+    bad = [rep["name"] for rep in resilience if not rep["ok"]]
+    if bad:
+        raise RuntimeError(
+            f"federation resilience drill(s) failed: {', '.join(bad)} "
+            f"(replay: python tools/chaos_replay.py --fed-drill <name> "
+            f"--seed {args.chaos_seed})"
+        )
 
     n = max(2, args.federation)
     names = [f"r{i}" for i in range(n)]
@@ -525,6 +546,31 @@ def run_federation_bench(args) -> int:
                 f"zero-work probes failed: repeat={repeat_ok} "
                 f"gossip={gossip_ok}"
             )
+        # SIGTERM-drain leg (ISSUE 12): the subprocess handler end to
+        # end — a live cell SIGTERM'd must announce the drain (DRAINING
+        # broadcast + successor handoff happen inside) and exit 0, not
+        # die mid-flight like the SIGKILL drill above.
+        sigterm_ok = None
+        tcell = next((c for c in cells if c.alive()), None)
+        if tcell is not None:
+            log(f"SIGTERM-drain leg: draining cell {tcell.name}")
+            tcell.proc.send_signal(signal.SIGTERM)
+            try:
+                tcell.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                sigterm_ok = False
+            else:
+                out = tcell.proc.stdout.read() or ""
+                sigterm_ok = (
+                    tcell.proc.returncode == 0 and "draining" in out
+                )
+            log(f"SIGTERM-drain leg: exit={tcell.proc.returncode} "
+                f"ok={sigterm_ok}")
+            if not sigterm_ok:
+                raise RuntimeError(
+                    f"SIGTERM drain failed on {tcell.name}: "
+                    f"exit={tcell.proc.returncode}"
+                )
         print(
             json.dumps(
                 {
@@ -547,6 +593,13 @@ def run_federation_bench(args) -> int:
                     "kill_drill_bit_exact": True,
                     "repeat_zero_work_all_replicas": repeat_ok,
                     "cross_replica_zero_work_probe": gossip_ok,
+                    "sigterm_drain_exit0": sigterm_ok,
+                    "resilience": {
+                        rep["name"]: {
+                            k: v for k, v in rep.items() if k != "name"
+                        }
+                        for rep in resilience
+                    },
                 }
             ),
             flush=True,
