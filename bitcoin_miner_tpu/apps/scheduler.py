@@ -40,13 +40,22 @@ Design notes (deliberately not a translation of anything):
   early.  The steal scan instead compares a running chunk's age against
   the FLEET's recent chunk-time p50: past ``steal_factor``× that (or an
   explicit :meth:`mark_straggler` from the PR-7 fleet detector), an idle
-  miner is handed the *tail* of the outstanding interval.  First
-  completed sub-interval wins; the straggler's eventual full-interval
-  Result folds harmlessly (min over a superset) and withdraws whatever
-  duplicate is still pending — the same interval-subtraction bookkeeping
-  the straggler re-queue uses, so split-on-steal stays bit-exact
-  (property-tested against from-scratch sweeps).  A steal-flagged miner
-  gets no new work until it answers or dies.
+  miner is handed the *tail* of the outstanding interval.  The cut
+  point is **rate-aware** (ISSUE 13 satellite): only the portion the
+  straggler cannot finish by its rate-proportional re-queue deadline —
+  predicted from its EWMA rate, crediting zero progress so far so the
+  steal can only overlap, never undershoot — is duplicated; a straggler
+  whose rate says it finishes in time is skipped (the full re-queue
+  stays the escalation), while a cold-rate or fleet-detector-marked
+  miner gets
+  the legacy half split (a marked miner's own EWMA is exactly what the
+  leave-one-out evidence distrusts).  First completed sub-interval
+  wins; the straggler's eventual full-interval Result folds harmlessly
+  (min over a superset) and withdraws whatever duplicate is still
+  pending — the same interval-subtraction bookkeeping the straggler
+  re-queue uses, so split-on-steal stays bit-exact (property-tested
+  against from-scratch sweeps).  A steal-flagged miner gets no new work
+  until it answers or dies.
 - **Pipelined assignment** (``pipeline_depth``, default 2): each miner
   holds up to depth outstanding chunks, results matched FIFO (LSP delivers
   in order and the miner processes in order).  Why: on tunnelled TPUs one
@@ -595,7 +604,9 @@ class Scheduler:
         docstring: straggler tail re-dispatch).  Age evidence is the
         FLEET's recent chunk-time p50 — a slow miner's own expected time
         would never flag it — gated on ``steal_min_samples`` so a cold
-        fleet never steals on guesses.  One steal per idle miner per
+        fleet never steals on guesses.  The cut point is rate-aware
+        (module docstring): only what the straggler cannot finish by its
+        re-queue deadline is duplicated.  One steal per idle miner per
         tick; a stolen front is never re-stolen (the full straggler
         re-queue is the escalation)."""
         idle = sum(1 for m in self.miners.values() if not m.queue)
@@ -620,7 +631,8 @@ class Scheduler:
             job = self.jobs.get(asgn.job)
             if job is None or job.prefill:
                 continue  # speculative work is not worth duplicating
-            if miner.conn_id not in self._marked_stragglers:
+            marked = miner.conn_id in self._marked_stragglers
+            if not marked:
                 if p50 is None:
                     continue
                 deadline = asgn.started_at + max(
@@ -628,12 +640,44 @@ class Scheduler:
                 )
                 if now < deadline:
                     continue
+            # Rate-aware cut point (ISSUE 13 satellite, carry-over from
+            # PR 10): steal only the portion the straggler cannot finish
+            # by its rate-proportional re-queue deadline
+            # (``straggler_factor ×`` its expected chunk time), predicted
+            # from its EWMA rate — the per-miner nonces/s the adaptive
+            # ladder already tracks (the scheduler-side view of the
+            # hist.miner_chunk_s samples).  Deliberately UNFLOORED: the
+            # 10 s ``straggler_min_seconds`` floor exists so the full
+            # re-queue never fires on timing noise, but crediting a
+            # chunk that already blew through the steal deadline with
+            # the floor's grace would let every target-sized (~0.5 s)
+            # chunk dodge the steal entirely.  The straggler sweeps low
+            # nonces first (decompose_range ascends), and crediting it
+            # zero progress so far underestimates where it will reach —
+            # the steal can only overlap, never leave a tail uncovered.
+            # An EXTERNALLY marked miner keeps the legacy half split:
+            # the fleet detector's leave-one-out evidence says its own
+            # EWMA is exactly what cannot be trusted.
+            tail = None
+            if not marked and miner.rate > 0.0:
+                expected = (hi - lo + 1) / miner.rate
+                requeue_at = (
+                    asgn.started_at + self.straggler_factor * expected
+                )
+                finishable = int(miner.rate * max(requeue_at - now, 0.0))
+                cut_from = lo + finishable
+                if cut_from > hi:
+                    # The straggler plausibly finishes the whole chunk
+                    # in its allotted time: stealing would be pure
+                    # duplication.  Re-evaluated every tick — as the
+                    # deadline nears, the unfinishable tail grows back.
+                    continue
+                tail = (max(cut_from, lo + 1), hi)
+            if tail is None:
+                # Cold rate (or external mark): the legacy upper half.
+                mid = lo + (hi - lo) // 2
+                tail = (mid + 1, hi)
             self._marked_stragglers.discard(miner.conn_id)
-            # Steal the upper half: the straggler sweeps low nonces first
-            # (decompose_range ascends), so the tail is the portion it is
-            # least likely to have reached.
-            mid = lo + (hi - lo) // 2
-            tail = (mid + 1, hi)
             asgn.stolen = tail
             job.pending.appendleft(tail)
             job.requeued.setdefault(miner.conn_id, []).append(tail)

@@ -190,7 +190,19 @@ def drill_shed_storm(seed: int = 1) -> dict:
         shedding_seen = _wait(
             lambda: r0.membership.load("r1") == LOAD_SHEDDING, 5.0
         )
-        time.sleep(1.0)  # several suspicion windows' worth of beats
+        # Several suspicion windows' worth of beats, sampling the peer's
+        # point-in-time view: flap damping (ISSUE 13 satellite) must hold
+        # SHEDDING across evidence-free beats instead of oscillating the
+        # fed.peer_state gauge OK<->SHEDDING on alternate gossip rounds.
+        flaps = 0
+        last = None
+        t_end = time.monotonic() + 1.0
+        while time.monotonic() < t_end:
+            cur = r0.membership.load("r1")
+            if last == LOAD_SHEDDING and cur != LOAD_SHEDDING:
+                flaps += 1
+            last = cur
+            time.sleep(0.05)
         liveness = r0.membership.liveness("r1")
         with r0._down_lock:
             marked_down = "r1" in r0._down
@@ -206,6 +218,7 @@ def drill_shed_storm(seed: int = 1) -> dict:
             and not marked_down
             and still_routable
             and false_susp == 0
+            and flaps <= 1
         )
         return {
             "name": "shed-storm",
@@ -216,6 +229,10 @@ def drill_shed_storm(seed: int = 1) -> dict:
             "marked_down": bool(marked_down),
             "still_routable": bool(still_routable),
             "false_suspicions": int(false_susp),
+            # Flap damping: one final SHEDDING->OK transition (the storm
+            # ending inside the sample window) is legitimate; oscillation
+            # is not.
+            "shed_flaps": int(flaps),
         }
     finally:
         for c in storm_conns:

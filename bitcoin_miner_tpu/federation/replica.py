@@ -269,6 +269,7 @@ class Replica:
         peer_down_ttl: float = 2.0,
         suspect_misses: float = 3.0,
         confirm_misses: float = 3.0,
+        shed_hold_beats: int = 3,
         incarnation: Optional[int] = None,
         workload=None,
         tick_interval: float = 0.25,
@@ -319,6 +320,14 @@ class Replica:
         self._draining = False  # guarded-by: lock
         self._refused: List[int] = []  # guarded-by: lock
         self._last_shed = 0  # heartbeat-to-heartbeat shed delta base  # guarded-by: lock
+        # Flap damping (ISSUE 13 satellite, carry-over from PR 12): once
+        # SHEDDING, the state holds for ``shed_hold_beats`` consecutive
+        # evidence-free beats before reverting to OK — a storm whose
+        # sheds land between alternate beats no longer oscillates the
+        # peer-side ``fed.peer_state`` gauge OK↔SHEDDING every round.
+        self._shed_hold_beats = max(0, int(shed_hold_beats))
+        self._shedding = False  # guarded-by: lock
+        self._shed_quiet = 0  # evidence-free beats while held  # guarded-by: lock
         self.gossip = SpanGossip(
             cell, self.spans, self.peers, self.lock,
             interval=gossip_interval, full_every=gossip_full_every,
@@ -433,17 +442,38 @@ class Replica:
         biting (sheds since the last heartbeat, or a deep backlog); OK
         otherwise.  SHEDDING tells peers "alive, deprioritize" — the
         whole point of the membership plane is that backpressure stops
-        reading as death."""
+        reading as death.
+
+        Flap damping (ISSUE 13 satellite): the point-in-time shed delta
+        flips on alternate beats under a bursty storm (sheds land between
+        one beat pair, not the next), which used to oscillate every
+        peer's ``fed.peer_state`` gauge OK↔SHEDDING each gossip round.
+        SHEDDING now enters on evidence immediately but exits only after
+        ``shed_hold_beats`` consecutive evidence-free beats; each held
+        beat counts ``fed.shed_holds``."""
+        held = False
         with self.lock:
             if self._draining:
                 return LOAD_DRAINING
             shed = self.gateway.shed_count
             backlog = len(self.gateway._queue)
-            shedding = (
+            evidence = (
                 shed > self._last_shed
                 or backlog >= max(1, self.gateway.max_queued) // 2
             )
             self._last_shed = shed
+            if evidence:
+                self._shedding = True
+                self._shed_quiet = 0
+            elif self._shedding:
+                self._shed_quiet += 1
+                if self._shed_quiet > self._shed_hold_beats:
+                    self._shedding = False  # hysteresis satisfied: back to OK
+                else:
+                    held = True
+            shedding = self._shedding
+        if held:
+            METRICS.inc("fed.shed_holds")
         return LOAD_SHEDDING if shedding else LOAD_OK
 
     def _heartbeat(self) -> dict:

@@ -92,6 +92,7 @@ def _build_call(
     tile: int,
     interpret: bool,
     cpb: Optional[int],
+    sieve: bool = False,
 ):
     """Build the pallas_call shared by the static and dynamic factories.
 
@@ -101,6 +102,23 @@ def _build_call(
     constants (static factory, one kernel per digit class) or runtime
     arguments (dynamic factory, one kernel for every k=6 class) is decided
     by the jit wrapper around the returned call.
+
+    ``sieve=True`` builds the TWO-STAGE variant (ISSUE 13): **pass 1**
+    hashes every lane in ``h0``-only output-mask form and reduces it to a
+    survivor predicate — ``h0 <= threshold`` in the sign-flipped int32
+    domain, against a device-carried running minimum seeded from the extra
+    ``thresh`` SMEM operand and tightened in SMEM scratch as the
+    sequential grid folds new minima (no host round-trip); **pass 2**
+    (the full ``(h0, h1)`` compression + lane-wise lexicographic fold +
+    accumulator read-modify-write — the per-lane bookkeeping the sieve
+    exists to skip) runs under ``pl.when`` only for groups containing a
+    survivor.  Ties (``h0 == threshold``) conservatively survive, so a
+    later lane equal on ``h0`` but smaller on ``(h1, nonce)`` is never
+    lost — bit-exactness vs the hashlib oracle holds by construction.
+    After the first dispatches the running min's ``h0`` falls like
+    ``U32_MAX / nonces_swept`` and survivor groups become a vanishing
+    fraction; steady state pays pass 1 only (see tools/roofline.py for
+    the per-pass op accounting).
 
     Returns ``(call, n_pad)``.
     """
@@ -142,8 +160,17 @@ def _build_call(
         # 2-D window to 512 B — (1024, 18) ate 512 KiB of the 1 MiB budget
         # and (2048, 18) overflowed it outright — while the 1-D form is
         # ~4 B/word (147 KiB at batch 2048).
+        thresh_ref = None
+        if sieve:  # extra SMEM operand: the host's running-min h0
+            thresh_ref, rest = rest[0], rest[1:]
         contrib_refs = rest[: len(cwords)]
-        h0_ref, h1_ref, idx_ref, a0_ref, a1_ref, ai_ref = rest[len(cwords) :]
+        th_ref = None
+        if sieve:
+            (
+                h0_ref, h1_ref, idx_ref, a0_ref, a1_ref, ai_ref, th_ref,
+            ) = rest[len(cwords) :]
+        else:
+            h0_ref, h1_ref, idx_ref, a0_ref, a1_ref, ai_ref = rest[len(cwords) :]
         g = pl.program_id(0)
         t = pl.program_id(1)
         rows = [g * cpb + j for j in range(cpb)]
@@ -159,6 +186,12 @@ def _build_call(
             a0_ref[...] = empty
             a1_ref[...] = empty
             ai_ref[...] = empty
+            if sieve:
+                # Seed the device-carried threshold from the dispatch
+                # operand; later programs only TIGHTEN it (pass 2 below),
+                # so the sieve sharpens across the sequential grid with
+                # no host round-trip.
+                th_ref[0] = thresh_ref[0]
 
         # Padding rows of a partial super-batch carry bounds (0, 0): a
         # fully-padded group skips all vector work with one scalar branch;
@@ -181,8 +214,10 @@ def _build_call(
                 # captured array constants.
                 k_table = jnp.stack([jnp.uint32(int(v)) for v in K])
 
-            l0 = l1 = li = None  # the group's lane-wise running min
-            for j in range(cpb):
+            def _row_state(j, final_form):
+                """Hash chunk row ``j``'s tile of lanes; the last block
+                compresses in ``final_form`` output-mask form (True →
+                ``(h0, h1)``, ``"h0"`` → pass 1's ``(h0,)``)."""
                 state = tuple(midstate_ref[s] for s in range(8))
                 for blk in range(n_tail_blocks):
                     w = []
@@ -202,9 +237,10 @@ def _build_call(
                             # vector one, measured on v5e).
                             w.append(base)
                     # The reduction reads only (h0, h1): the last block's
-                    # compression drops the work feeding the 6 dead digest
-                    # words (final_only).
+                    # compression drops the work feeding the dead digest
+                    # words (final_only / its "h0" output-mask form).
                     last = blk == n_tail_blocks - 1
+                    fo = final_form if last else False
                     # Mosaic wants the unrolled straight-line rounds
                     # (registers, software pipelining); interpret mode
                     # traces the kernel as plain XLA ops, where the
@@ -212,47 +248,89 @@ def _build_call(
                     # minutes-long LLVM compiles — roll it.
                     if interpret:
                         state = compress_rolled(
-                            state, w, k_table=k_table, final_only=last
+                            state, w, k_table=k_table, final_only=fo
                         )
                     else:
-                        state = compress(state, w, final_only=last)
+                        state = compress(state, w, final_only=fo)
+                return state
 
-                valid = (i >= los[j]) & (i < his[j])
-                h0 = jnp.where(valid, state[0], jnp.uint32(U32_MAX))
-                h1 = jnp.where(valid, state[1], jnp.uint32(U32_MAX))
-                # Mosaic has no unsigned reductions: compare in the sign-
-                # flipped int32 domain, where u32 order == s32 order
-                # (x ^ 0x8000_0000).
-                h0b = jax.lax.bitcast_convert_type(h0 ^ sbit, jnp.int32)
-                h1b = jax.lax.bitcast_convert_type(h1 ^ sbit, jnp.int32)
-                idx = jnp.where(
-                    valid, rows[j] * n_lanes + i, jnp.int32(I32_MAX)
-                )
-                if l0 is None:
-                    l0, l1, li = h0b, h1b, idx
-                else:
-                    better = (h0b < l0) | (
-                        (h0b == l0)
-                        & ((h1b < l1) | ((h1b == l1) & (idx < li)))
+            def _full_fold():
+                """The full (h0, h1) lexicographic min-fold + accumulator
+                read-modify-write — the baseline kernel's whole body, and
+                the sieve kernel's survivor-only pass 2."""
+                l0 = l1 = li = None  # the group's lane-wise running min
+                for j in range(cpb):
+                    state = _row_state(j, True)
+                    valid = (i >= los[j]) & (i < his[j])
+                    h0 = jnp.where(valid, state[0], jnp.uint32(U32_MAX))
+                    h1 = jnp.where(valid, state[1], jnp.uint32(U32_MAX))
+                    # Mosaic has no unsigned reductions: compare in the
+                    # sign-flipped int32 domain, where u32 order == s32
+                    # order (x ^ 0x8000_0000).
+                    h0b = jax.lax.bitcast_convert_type(h0 ^ sbit, jnp.int32)
+                    h1b = jax.lax.bitcast_convert_type(h1 ^ sbit, jnp.int32)
+                    idx = jnp.where(
+                        valid, rows[j] * n_lanes + i, jnp.int32(I32_MAX)
                     )
-                    l0 = jnp.where(better, h0b, l0)
-                    l1 = jnp.where(better, h1b, l1)
-                    li = jnp.where(better, idx, li)
+                    if l0 is None:
+                        l0, l1, li = h0b, h1b, idx
+                    else:
+                        better = (h0b < l0) | (
+                            (h0b == l0)
+                            & ((h1b < l1) | ((h1b == l1) & (idx < li)))
+                        )
+                        l0 = jnp.where(better, h0b, l0)
+                        l1 = jnp.where(better, h1b, l1)
+                        li = jnp.where(better, idx, li)
 
-            # Lane-wise lexicographic running min: pure compare/select, no
-            # cross-lane reduction — those cost ~2 us/program and were ~35%
-            # of kernel time (measured v5e); they run once per DISPATCH in
-            # _final below.  One scratch read-modify-write per group (grid
-            # programs execute sequentially per core, so this is safe).
-            p0 = a0_ref[...]
-            p1 = a1_ref[...]
-            pi = ai_ref[...]
-            better = (l0 < p0) | (
-                (l0 == p0) & ((l1 < p1) | ((l1 == p1) & (li < pi)))
-            )
-            a0_ref[...] = jnp.where(better, l0, p0)
-            a1_ref[...] = jnp.where(better, l1, p1)
-            ai_ref[...] = jnp.where(better, li, pi)
+                # Lane-wise lexicographic running min: pure compare/select,
+                # no cross-lane reduction — those cost ~2 us/program and
+                # were ~35% of kernel time (measured v5e); they run once
+                # per DISPATCH in _final below.  One scratch read-modify-
+                # write per group (grid programs execute sequentially per
+                # core, so this is safe).
+                p0 = a0_ref[...]
+                p1 = a1_ref[...]
+                pi = ai_ref[...]
+                better = (l0 < p0) | (
+                    (l0 == p0) & ((l1 < p1) | ((l1 == p1) & (li < pi)))
+                )
+                a0_ref[...] = jnp.where(better, l0, p0)
+                a1_ref[...] = jnp.where(better, l1, p1)
+                ai_ref[...] = jnp.where(better, li, pi)
+
+            if not sieve:
+                _full_fold()
+            else:
+                # ---- pass 1: h0-only hash → survivor predicate.  The
+                # epilogue per row is mask + select + flip + compare + OR
+                # (~8 vector ops/lane/group) instead of the full fold's
+                # ~22 (tools/roofline.py) — and NO h1 chain.
+                th = th_ref[0]
+                surv = None
+                for j in range(cpb):
+                    (h0,) = _row_state(j, "h0")
+                    h0 = jnp.where(
+                        (i >= los[j]) & (i < his[j]), h0, jnp.uint32(U32_MAX)
+                    )
+                    h0b = jax.lax.bitcast_convert_type(h0 ^ sbit, jnp.int32)
+                    # <= not <: a tie on h0 may still win on (h1, nonce)
+                    # — conservative tie survival keeps bit-exactness.
+                    # Masked lanes (I32_MAX) survive only the degenerate
+                    # U32_MAX threshold, where pass 2 masks them anyway.
+                    s = h0b <= th
+                    surv = s if surv is None else (surv | s)
+
+                # ---- pass 2: survivor groups only — after the first few
+                # dispatches a vanishing fraction (the running min's h0
+                # falls like U32_MAX / nonces_swept).
+                @pl.when(jnp.any(surv))
+                def _survivors():
+                    _full_fold()
+                    # Tighten the device-carried threshold to the new
+                    # accumulator minimum: later groups in this dispatch
+                    # sieve against the freshest bound.
+                    th_ref[0] = jnp.minimum(th_ref[0], jnp.min(a0_ref[...]))
 
         # Last program: one cross-lane lexicographic argmin over the
         # accumulator tile -> the three SMEM output scalars.
@@ -274,7 +352,11 @@ def _build_call(
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # midstate (8,)
         pl.BlockSpec(memory_space=pltpu.SMEM),  # tail_const+bounds, flat (B*(nw+2),)
-    ] + [
+    ]
+    if sieve:
+        # The running-min threshold operand (1,), sign-flipped int32.
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    in_specs += [
         pl.BlockSpec((sub, 128), lambda g, t: (t, 0), memory_space=pltpu.VMEM)
         for _ in cwords
     ]
@@ -284,6 +366,11 @@ def _build_call(
         jax.ShapeDtypeStruct((1,), jnp.int32),  # sign-flipped h1
         jax.ShapeDtypeStruct((1,), jnp.int32),
     ]
+    scratch = [pltpu.VMEM((sub, 128), jnp.int32) for _ in range(3)]
+    if sieve:
+        # The device-carried threshold: persists across the sequential
+        # grid like the accumulators (SMEM — it is one scalar).
+        scratch.append(pltpu.SMEM((1,), jnp.int32))
 
     call = pl.pallas_call(
         kernel,
@@ -291,7 +378,7 @@ def _build_call(
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        scratch_shapes=[pltpu.VMEM((sub, 128), jnp.int32) for _ in range(3)],
+        scratch_shapes=scratch,
         interpret=interpret,
     )
     return call, n_pad
@@ -314,6 +401,7 @@ def make_pallas_minhash(
     tile: int = DEFAULT_TILE,
     interpret: bool = False,
     cpb: Optional[int] = None,
+    sieve: bool = False,
 ):
     """Build the jitted Pallas min-hash for one (layout, k, batch) class.
 
@@ -322,11 +410,29 @@ def make_pallas_minhash(
     whole (B, 10^k) lane grid (hashes in the sign-flipped-int32 domain are
     compared; outputs are plain uint32), flat_idx = chunk_row * 10^k + lane,
     I32_MAX when every lane is masked out by bounds.
+
+    ``sieve=True`` builds the two-stage variant (see :func:`_build_call`):
+    the fn takes an extra ``thresh (1,) int32`` operand (the host's
+    running-min h0, sign-flipped) and ``flat_idx == I32_MAX`` now also
+    means "no lane survived the threshold" — the host keeps its best.
     """
     cwords = _contrib_words(low_pos)
     call, n_pad = _build_call(
-        n_tail_blocks, cwords, k, batch, tile, interpret, cpb
+        n_tail_blocks, cwords, k, batch, tile, interpret, cpb, sieve=sieve
     )
+
+    if sieve:
+
+        @jax.jit
+        def minhash(midstate, tailc_bounds, thresh):
+            contribs = tuple(
+                jnp.asarray(c) for c in _digit_contrib_np(k, low_pos, n_pad)
+            )
+            return _unflip(
+                *call(midstate, tailc_bounds.reshape(-1), thresh, *contribs)
+            )
+
+        return minhash
 
     @jax.jit
     def minhash(midstate, tailc_bounds):
@@ -375,6 +481,7 @@ def make_pallas_minhash_dyn(
     tile: int = DEFAULT_TILE,
     interpret: bool = False,
     cpb: Optional[int] = None,
+    sieve: bool = False,
 ):
     """Digit-position-DYNAMIC variant: one compiled kernel for every digit
     class whose k low digits land in tail words ``[w_lo, w_hi]`` — i.e. all
@@ -394,12 +501,24 @@ def make_pallas_minhash_dyn(
 
     Returned fn: ``(midstate, tailc_bounds, *contribs)`` ->
     ``(min_h0, min_h1, flat_idx)``; contribs must have length
-    ``w_hi - w_lo + 1`` (see :func:`window_contribs_np`).
+    ``w_hi - w_lo + 1`` (see :func:`window_contribs_np`).  With
+    ``sieve=True`` the fn takes ``(midstate, tailc_bounds, thresh,
+    *contribs)`` — the two-stage variant of :func:`_build_call`.
     """
     cwords = tuple(range(w_lo, w_hi + 1))
     call, n_pad = _build_call(
-        n_tail_blocks, cwords, k, batch, tile, interpret, cpb
+        n_tail_blocks, cwords, k, batch, tile, interpret, cpb, sieve=sieve
     )
+
+    if sieve:
+
+        @jax.jit
+        def minhash(midstate, tailc_bounds, thresh, *contribs):
+            return _unflip(
+                *call(midstate, tailc_bounds.reshape(-1), thresh, *contribs)
+            )
+
+        return minhash, n_pad
 
     @jax.jit
     def minhash(midstate, tailc_bounds, *contribs):

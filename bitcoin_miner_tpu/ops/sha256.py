@@ -65,7 +65,9 @@ def _rotr(x, n: int):
     return (x >> n) | (x << (jnp.uint32(32) - n))
 
 
-def compress(state: Sequence, w: Sequence, final_only: bool = False) -> Tuple:
+def compress(
+    state: Sequence, w: Sequence, final_only: "bool | str" = False
+) -> Tuple:
     """One SHA-256 compression of a 16-word block.
 
     ``state``: 8 uint32 arrays (any broadcastable shape); ``w``: 16 uint32
@@ -80,6 +82,16 @@ def compress(state: Sequence, w: Sequence, final_only: bool = False) -> Tuple:
     and 6 of the 8 final state additions (every other round op feeds the
     live pair transitively, so this is all the dead code there is).
 
+    ``final_only="h0"`` is the output-mask extension (ISSUE 13): the
+    sieve kernel's pass 1 reads ONLY ``h0`` — the survivor predicate is
+    ``h0 <= threshold`` — so the last block returns just ``(out_a,)``
+    and additionally drops ``h1``'s final state addition.  Every round
+    op still feeds ``h0`` transitively (``t2`` needs round 62's ``a``),
+    so one more add is all the extra dead code there is; pass 1's real
+    savings is the reduction epilogue it replaces (see
+    ops/pallas_sha256.py's sieve kernel and tools/roofline.py for the
+    per-pass op accounting).
+
     Lazy-broadcast constant folding: callers may pass *scalars* (or any
     lower-rank shape) for message words that are constant across the lane
     axis — per-chunk template words whose digits were folded host-side.
@@ -88,8 +100,13 @@ def compress(state: Sequence, w: Sequence, final_only: bool = False) -> Tuple:
     chosen so constant terms meet each other before any vector term:
     rounds consuming only constant words run entirely off the VPU, K[t]
     folds into constant wt for free, and σ0/σ1 of constant schedule words
-    never hit the vector lanes.  ~7% of the Pallas tier's vector ops on the
-    flagship shape (see tools/roofline.py for the op accounting).
+    never hit the vector lanes.  Exact folded counts on the flagship
+    shape ('cmu440', d=10, k=6; tools/roofline.py, r13): 3002 vector ops
+    per lane for the full final_only compression (3001 in the sieve's
+    "h0" output-mask form) + a 21.6-op reduction epilogue for the
+    baseline kernel vs 7.6 for the sieve's pass-1 survivor predicate —
+    the compression dominates, which is why the sieve's steady-state
+    op-model gain on this shape is ~0.5%, all of it epilogue.
     """
     a, b, c, d, e, f, g, h = state
     w = list(w)
@@ -112,8 +129,8 @@ def compress(state: Sequence, w: Sequence, final_only: bool = False) -> Tuple:
             wt = (w[t % 16] + s0) + (w[(t - 7) % 16] + s1)
             w[t % 16] = wt
         s1e = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-        # ch/maj in their 3-op / 3-op forms (vs 4/5 naive) — ~8% of the
-        # kernel's total vector ops at 64 rounds:
+        # ch/maj in their 3-op / 3-op forms (vs 4/5 naive) — ~6% of the
+        # flagship compression's 3002 folded vector ops (roofline r13):
         #   ch  = (e&f) ^ (~e&g)          == g ^ (e & (f ^ g))
         #   maj = (a&b) ^ (a&c) ^ (b&c)   == b ^ ((b^a) & (b^c)),
         #         with (b^c) reused from last round's (a^b)
@@ -126,6 +143,8 @@ def compress(state: Sequence, w: Sequence, final_only: bool = False) -> Tuple:
         prev_xab = xab
         t2 = s0a + maj
         if final_only and t == 63:
+            if final_only == "h0":  # output-mask: h1's add is dead too
+                return ((t1 + t2) + state[0],)
             return ((t1 + t2) + state[0], a + state[1])
         h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
     s = (a, b, c, d, e, f, g, h)
@@ -134,7 +153,7 @@ def compress(state: Sequence, w: Sequence, final_only: bool = False) -> Tuple:
 
 
 def compress_rolled(
-    state: Sequence, w: Sequence, k_table=None, final_only: bool = False
+    state: Sequence, w: Sequence, k_table=None, final_only: "bool | str" = False
 ) -> Tuple:
     """One SHA-256 compression with the 64 rounds as ``lax.fori_loop``s.
 
@@ -191,7 +210,9 @@ def compress_rolled(
 
     st, wbuf = lax.fori_loop(0, 16, lambda t, c: phase1(t, c), (st0, wbuf))
     st, _ = lax.fori_loop(16, 64, lambda t, c: phase2(t, c), (st, wbuf))
-    if final_only:  # same contract as compress(final_only=True): (a, b) only
+    if final_only:  # same contract as compress: (a, b), or (a,) for "h0"
+        if final_only == "h0":
+            return (st[0] + st0[0],)
         return (st[0] + st0[0], st[1] + st0[1])
     return tuple(x + y for x, y in zip(st, st0))
 

@@ -109,6 +109,7 @@ def make_kernel_body(
     k: int,
     batch: int,
     rolled: Optional[bool] = None,
+    sieve: bool = False,
 ):
     """Build the pure (un-jitted) min-hash kernel body for one
     (layout, k, batch) shape class.
@@ -122,13 +123,24 @@ def make_kernel_body(
     ``rolled`` picks the compression form: the unrolled straight-line DAG
     (best on TPU — fused, register-resident) vs the fori_loop form (XLA:CPU
     chokes on the unrolled DAG's LLVM compile).  None = by platform.
+
+    ``sieve=True`` is the two-stage variant (ISSUE 13): the fn takes an
+    extra uint32 scalar ``thresh`` (the host's running-min h0); pass 1
+    hashes every lane in h0-only output-mask form and reduces it to one
+    ``any(h0 <= thresh)`` survivor bit (ties conservatively survive);
+    the full ``(h0, h1)`` fold + argmin runs under ``lax.cond`` only
+    when a survivor exists, else ``(U32_MAX, U32_MAX, I32_MAX)`` comes
+    back and the host keeps its best.  This tier has no sequential grid,
+    so the threshold tightens only between dispatches (host-side);
+    the pallas tier also tightens it across the grid in SMEM scratch.
     """
     n_lanes = 10**k
     if rolled is None:
         rolled = not is_tpu()
     comp = compress_rolled if rolled else compress
 
-    def kernel(midstate, tail_const, bounds):
+    def _assemble(midstate, tail_const):
+        """Shared w-word assembly: per-block word lists + initial state."""
         i = jnp.arange(n_lanes, dtype=jnp.int32)
         # ASCII of the k low decimal digits of each lane index.
         contrib = {}
@@ -138,6 +150,7 @@ def make_kernel_body(
             contrib[dp.word] = contrib[dp.word] | dig if dp.word in contrib else dig
 
         state = tuple(midstate[s] for s in range(8))  # scalars, broadcast below
+        blocks = []
         for b in range(n_tail_blocks):
             w = []
             for widx in range(b * 16, (b + 1) * 16):
@@ -146,9 +159,20 @@ def make_kernel_body(
                     w.append(col | contrib[widx][None, :])  # (B, N)
                 else:
                     w.append(col)
-            # Last block: only (h0, h1) survive into the reduction, so skip
-            # the dead digest words (compress final_only).
-            state = comp(state, w, final_only=(b == n_tail_blocks - 1))
+            blocks.append(w)
+        return i, state, blocks
+
+    def _hash(state, blocks, final_form):
+        """Run the blocks; the last compresses in ``final_form`` output-
+        mask form (True → (h0, h1), "h0" → pass 1's (h0,))."""
+        for b, w in enumerate(blocks):
+            last = b == n_tail_blocks - 1
+            state = comp(state, w, final_only=(final_form if last else False))
+        return state
+
+    def _fold(i, state, bounds):
+        """The full lexicographic min + argmin reduction (both tiers'
+        pass 2; the whole baseline kernel)."""
         h0 = jnp.broadcast_to(state[0], (batch, n_lanes))
         h1 = jnp.broadcast_to(state[1], (batch, n_lanes))
 
@@ -169,6 +193,39 @@ def make_kernel_body(
         flat_idx = jnp.min(jnp.where(e1, flat, jnp.int32(I32_MAX)))
         return min_h0, min_h1, flat_idx
 
+    if not sieve:
+
+        def kernel(midstate, tail_const, bounds):
+            i, state, blocks = _assemble(midstate, tail_const)
+            # Last block: only (h0, h1) survive into the reduction, so
+            # skip the dead digest words (compress final_only).
+            return _fold(i, _hash(state, blocks, True), bounds)
+
+        return kernel
+
+    def kernel(midstate, tail_const, bounds, thresh):
+        from jax import lax
+
+        i, state, blocks = _assemble(midstate, tail_const)
+        # Pass 1: h0 only (output-mask form), one survivor bit.
+        (p1_h0,) = _hash(state, blocks, "h0")
+        h0 = jnp.broadcast_to(p1_h0, (batch, n_lanes))
+        valid = (i[None, :] >= bounds[:, :1]) & (i[None, :] < bounds[:, 1:2])
+        h0 = jnp.where(valid, h0, jnp.uint32(U32_MAX))
+        # <= not <: an h0 tie may still win on (h1, nonce) — conservative
+        # tie survival keeps bit-exactness vs the oracle.
+        surv = jnp.any(h0 <= thresh)
+
+        def _pass2(_):
+            return _fold(i, _hash(state, blocks, True), bounds)
+
+        def _none(_):
+            return (
+                jnp.uint32(U32_MAX), jnp.uint32(U32_MAX), jnp.int32(I32_MAX),
+            )
+
+        return lax.cond(surv, _pass2, _none, 0)
+
     return kernel
 
 
@@ -179,9 +236,12 @@ def _make_kernel(
     k: int,
     batch: int,
     rolled: bool,
+    sieve: bool = False,
 ):
     """Jitted single-device wrapper over :func:`make_kernel_body`."""
-    return jax.jit(make_kernel_body(n_tail_blocks, low_pos, k, batch, rolled))
+    return jax.jit(
+        make_kernel_body(n_tail_blocks, low_pos, k, batch, rolled, sieve=sieve)
+    )
 
 
 @lru_cache(maxsize=256)
@@ -250,11 +310,28 @@ def _default_backend() -> str:
 
 
 def auto_tune(
-    backend: Optional[str], batch: Optional[int], max_k: Optional[int]
-) -> Tuple[str, int, int]:
-    """Resolve the (backend, rows-per-dispatch, max_k) defaults shared by the
-    single-device and sharded sweep drivers.  max_k=5 bounds the xla tier's
-    compress_rolled schedule buffer ((16, B, 10^k) u32) to ~50 MB at B=8."""
+    backend: Optional[str],
+    batch: Optional[int],
+    max_k: Optional[int],
+    sieve: Optional[bool] = None,
+) -> Tuple[str, int, int, bool]:
+    """Resolve the (backend, rows-per-dispatch, max_k, sieve) defaults
+    shared by the single-device and sharded sweep drivers.  max_k=5 bounds
+    the xla tier's compress_rolled schedule buffer ((16, B, 10^k) u32) to
+    ~50 MB at B=8.
+
+    The **sieve rung** (ISSUE 13, ``sieve=None`` = auto): the two-stage
+    sieve kernel is ON for the pallas tier — pass 1's predicate epilogue
+    is ~8 vector ops/lane against the ~22 of the per-lane argmin
+    bookkeeping it replaces (tools/roofline.py prints both), and
+    survivor groups vanish as the running min falls like
+    ``U32_MAX / nonces_swept`` — and OFF for the xla tier, where the
+    sieve measurably LOSES: compress_rolled re-materialises the full
+    (16, B, 10^k) schedule buffer per pass and ``lax.cond`` re-runs the
+    whole compression on survivor dispatches, so the baseline kernel
+    stays (measured on this host, both legs in BENCH_pr13.json;
+    ``bench.py --sieve-compare`` re-measures any shape).  A shape where
+    the sieve loses therefore keeps the current kernel by default."""
     if backend is None:
         backend = _default_backend()
     if batch is None:
@@ -268,7 +345,9 @@ def auto_tune(
         batch = 1024 if backend == "pallas" else 4
     if max_k is None:
         max_k = 6 if backend == "pallas" else 5
-    return backend, batch, max_k
+    if sieve is None:
+        sieve = backend == "pallas"
+    return backend, batch, max_k, sieve
 
 
 @dataclass(frozen=True)
@@ -403,10 +482,13 @@ def _window_contribs_dev(k, low_pos, w_lo, w_hi, n_pad):
     )
 
 
-def _build_kernel(backend, batch, tile, cpb, interpret, rolled, layout, group):
+def _build_kernel(
+    backend, batch, tile, cpb, interpret, rolled, layout, group, sieve=False
+):
     """One place for the backend-specific kernel construction (shared by
     the synchronous driver and SweepPipeline; the underlying factories are
-    lru_cached).
+    lru_cached).  ``sieve`` picks the two-stage variant of whichever
+    backend kernel applies (ISSUE 13).
 
     The pallas tier uses the digit-position-DYNAMIC kernel: one compiled
     executable serves every digit class d in [k+1, 20] of this data length
@@ -437,6 +519,7 @@ def _build_kernel(backend, batch, tile, cpb, interpret, rolled, layout, group):
                 tile=tile if tile is not None else DEFAULT_TILE,
                 interpret=interpret,
                 cpb=cpb,
+                sieve=sieve,
             )
         w_lo, w_hi = window
         fn, n_pad = make_pallas_minhash_dyn(
@@ -448,25 +531,48 @@ def _build_kernel(backend, batch, tile, cpb, interpret, rolled, layout, group):
             tile=tile if tile is not None else DEFAULT_TILE,
             interpret=interpret,
             cpb=cpb,
+            sieve=sieve,
         )
         contribs = _window_contribs_dev(group.k, low_pos, w_lo, w_hi, n_pad)
 
-        def kern(midstate, tailc_bounds, _fn=fn, _c=contribs):
-            return _fn(midstate, tailc_bounds, *_c)
+        # *th is empty (baseline) or the one threshold operand (sieve):
+        # one wrapper serves both calling conventions.
+        def kern(midstate, tailc_bounds, *th, _fn=fn, _c=contribs):
+            return _fn(midstate, tailc_bounds, *th, *_c)
 
         kern.class_key = fn
         return kern
-    return _make_kernel(layout.n_tail_blocks, low_pos, group.k, batch, rolled)
+    return _make_kernel(
+        layout.n_tail_blocks, low_pos, group.k, batch, rolled, sieve
+    )
 
 
-def _invoke_kernel(backend, kern, midstate, tail_const, bounds):
+def _invoke_kernel(backend, kern, midstate, tail_const, bounds, thresh=None):
     """One place for the backend-specific calling convention (the pallas
-    tier takes the chunk table + bounds as one flattened operand)."""
+    tier takes the chunk table + bounds as one flattened operand).
+
+    ``thresh`` (sieve kernels only): the host's running-min h0 as a plain
+    int in [0, U32_MAX] — U32_MAX (everything survives) until the first
+    candidate lands.  The pallas tier wants it pre-sign-flipped int32
+    (its comparisons live in that domain); the xla tier compares uint32
+    directly."""
     if backend == "pallas":
         tailcb = np.concatenate([tail_const, bounds.astype(np.uint32)], axis=1)
-        return kern(jnp.asarray(midstate), jnp.asarray(tailcb))
+        if thresh is None:
+            return kern(jnp.asarray(midstate), jnp.asarray(tailcb))
+        tflip = np.array([thresh ^ 0x80000000], dtype=np.uint32).view(np.int32)
+        return kern(
+            jnp.asarray(midstate), jnp.asarray(tailcb), jnp.asarray(tflip)
+        )
+    if thresh is None:
+        return kern(
+            jnp.asarray(midstate), jnp.asarray(tail_const), jnp.asarray(bounds)
+        )
     return kern(
-        jnp.asarray(midstate), jnp.asarray(tail_const), jnp.asarray(bounds)
+        jnp.asarray(midstate),
+        jnp.asarray(tail_const),
+        jnp.asarray(bounds),
+        jnp.uint32(thresh),
     )
 
 
@@ -517,6 +623,7 @@ class SweepPipeline:
         mesh=None,
         axis_name: str = "miners",
         workload=None,
+        sieve: Optional[bool] = None,
     ) -> None:
         import queue as _queue
         import threading
@@ -535,7 +642,15 @@ class SweepPipeline:
 
             if not is_tpu_device(mesh.devices.flat[0]):
                 backend = "xla"
-        self._backend, self._batch, self._max_k = auto_tune(backend, batch, max_k)
+        self._backend, self._batch, self._max_k, self._sieve = auto_tune(
+            backend, batch, max_k, sieve
+        )
+        if mesh is not None:
+            # The sharded tier keeps the baseline kernel: its collective
+            # argmin cascade needs every device's minimum each dispatch,
+            # so a per-shard survivor predicate saves nothing yet (the
+            # per-shard sieve is a named ROADMAP follow-on).
+            self._sieve = False
         self._tile = tile
         self._cpb = cpb
         self._interpret = interpret
@@ -660,7 +775,10 @@ class SweepPipeline:
             with self._class_lock(kern):
                 if key in self._warm_keys:
                     return
-                out = self._invoke(kern, midstate, tail_const, bounds)
+                out = self._invoke(
+                    kern, midstate, tail_const, bounds,
+                    thresh=U32_MAX if self._sieve else None,
+                )
                 for o in out:
                     o.block_until_ready()
                 self._warm_keys.add(key)
@@ -707,9 +825,10 @@ class SweepPipeline:
             self._rolled,
             layout,
             group,
+            sieve=self._sieve,
         )
 
-    def _invoke(self, kern, midstate, tail_const, bounds):
+    def _invoke(self, kern, midstate, tail_const, bounds, thresh=None):
         if self._mesh is not None:
             from ..parallel.sweep import sharded_invoke
 
@@ -717,7 +836,9 @@ class SweepPipeline:
                 kern, midstate, tail_const, bounds,
                 self._mesh, self._axis_name,
             )
-        return _invoke_kernel(self._backend, kern, midstate, tail_const, bounds)
+        return _invoke_kernel(
+            self._backend, kern, midstate, tail_const, bounds, thresh=thresh
+        )
 
     def _class_lock(self, kern):
         import threading
@@ -745,8 +866,17 @@ class SweepPipeline:
                 # lock is uncontended in steady state.  The enqueue stamp
                 # rides with the handle so the fetcher can report each
                 # dispatch's enqueue→fetch time (hist.device_dispatch_s).
+                th = None
+                if self._sieve:
+                    # Sieve threshold: the running-min h0 known at ENQUEUE
+                    # time (the fetcher updates state["best"]; a stale —
+                    # looser — read is conservative-correct, so no lock).
+                    b = state["best"]
+                    th = (b[0][0] >> 32) if b else U32_MAX
                 with self._class_lock(kern):
-                    out = self._invoke(kern, midstate, tail_const, bounds)
+                    out = self._invoke(
+                        kern, midstate, tail_const, bounds, thresh=th
+                    )
                     self._warm_keys.add(getattr(kern, "class_key", kern))
                     return (out, _time.monotonic())
 
@@ -863,6 +993,7 @@ def sweep_min_hash(
     interpret: bool = False,
     host_lane_budget: int = 0,
     workload=None,
+    sieve: Optional[bool] = None,
 ) -> SweepResult:
     """Find ``(min Hash(data, n), argmin n)`` over inclusive ``[lower,
     upper]`` on the default JAX device.  Bit-exact vs the hashlib oracle
@@ -881,20 +1012,32 @@ def sweep_min_hash(
     ``tile`` = lanes per pallas grid program (VMEM blocking; pallas only).
     ``cpb`` = chunk rows per pallas grid program (amortises per-program
     fixed cost; must divide ``batch``; None = largest divisor up to 8).
+    ``sieve`` = the two-stage sieve kernel (ISSUE 13; None = the
+    :func:`auto_tune` rung for this backend): dispatches carry the
+    running-min h0 as a threshold operand and the full fold runs only on
+    survivors — bit-exact either way (ties conservatively survive).
     """
-    backend, batch, max_k = auto_tune(backend, batch, max_k)
+    backend, batch, max_k, sieve = auto_tune(backend, batch, max_k, sieve)
     rolled = not is_tpu()
     sep, host_min, _native_ok = _workload_knobs(workload)
 
+    best: List[Tuple[int, int]] = []  # [(hash, nonce)] — current minimum
+
     def get_kernel(layout, group):
         return _build_kernel(
-            backend, batch, tile, cpb, interpret, rolled, layout, group
+            backend, batch, tile, cpb, interpret, rolled, layout, group,
+            sieve=sieve,
         )
 
     def run_kernel(kern, midstate, tail_const, bounds):
-        return _invoke_kernel(backend, kern, midstate, tail_const, bounds)
-
-    best: List[Tuple[int, int]] = []  # [(hash, nonce)] — current minimum
+        th = None
+        if sieve:
+            # The running-min h0 at enqueue time; pipelined dispatches may
+            # carry a stale (looser) bound — conservative-correct.
+            th = (best[0][0] >> 32) if best else U32_MAX
+        return _invoke_kernel(
+            backend, kern, midstate, tail_const, bounds, thresh=th
+        )
 
     def consume(out, bases, n_lanes):
         if isinstance(out, HostFold):
@@ -905,7 +1048,9 @@ def sweep_min_hash(
         h0, h1, flat_idx = out
         fi = int(flat_idx)
         if fi == I32_MAX:
-            return  # fully-masked call (shouldn't happen with real chunks)
+            # Fully-masked call, or (sieve) no lane beat the threshold —
+            # the running minimum stands.
+            return
         h = (int(h0) << 32) | int(h1)
         cand = (h, bases[fi // n_lanes] + fi % n_lanes)
         if not best or cand < best[0]:

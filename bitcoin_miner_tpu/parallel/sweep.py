@@ -299,7 +299,13 @@ def sweep_min_hash_sharded(
     mesh_on_tpu = is_tpu_device(mesh.devices.flat[0])
     if backend is None and not mesh_on_tpu:
         backend = "xla"
-    backend, batch_per_device, max_k = auto_tune(backend, batch_per_device, max_k)
+    # The sharded tier keeps the baseline kernel (auto_tune's sieve rung
+    # is single-device only): the collective argmin cascade needs every
+    # device's minimum each dispatch — a per-shard sieve is a ROADMAP
+    # follow-on.
+    backend, batch_per_device, max_k, _sieve = auto_tune(
+        backend, batch_per_device, max_k, sieve=False
+    )
     rolled = not mesh_on_tpu
     batch = n_dev * batch_per_device
 

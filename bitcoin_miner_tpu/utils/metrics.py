@@ -349,6 +349,7 @@ def format_quantiles(h) -> str:
 #:   fed.suspected             peers marked SUSPECT by the failure detector
 #:   fed.false_suspicions      suspects that heartbeat again before the confirmation window
 #:   fed.handoff_jobs          resumable identities imported from a draining peer
+#:   fed.shed_holds            heartbeats held SHEDDING by flap-damping hysteresis
 #:   fed.peer_state            per-peer membership gauge (fed.peer_state.<peer>: 0 OK .. 4 DEAD)
 #:   gossip.retransmits        unacked delta spans resent by the ack-gap recovery
 #:   miner.nonces              nonces swept by this process's miner loop

@@ -251,6 +251,42 @@ def test_trace_pass_does_not_flag_static_branches(tmp_path):
     assert _pass_findings("trace", tmp_path) == []
 
 
+def test_trace_pass_collects_sieve_kernel_bodies():
+    """ISSUE 13 coverage meta-test: the trace-safety lint must SEE the
+    two-stage sieve kernel paths — both passes, both backends — exactly
+    like the baseline kernels.  The sieve bodies live inside the factory
+    convention (``make_kernel_body`` / ``_build_call`` /
+    ``make_pallas_minhash*``), so _collect_kernel_bodies must return
+    them; if a refactor ever moves them outside the convention, this
+    test (not silence) is what fails."""
+    import ast
+
+    from tools.analyze.common import file_comments
+    from tools.analyze.tracecheck import FACTORY_RE, _collect_kernel_bodies
+
+    # The sieve factory naming is part of the convention now.
+    assert FACTORY_RE.search("make_pallas_sieve")
+    collected = {}
+    for mod in ("ops/sweep.py", "ops/pallas_sha256.py"):
+        src = (REPO / "bitcoin_miner_tpu" / mod).read_text()
+        tree = ast.parse(src)
+        names = [
+            fn.name
+            for fn in _collect_kernel_bodies(tree, file_comments(src))
+        ]
+        collected[mod] = names
+    # ops/sweep.py: the xla tier's baseline AND sieve kernel bodies (two
+    # defs named `kernel`) plus the shared assemble/hash/fold helpers
+    # pass 1 and pass 2 run through.
+    assert collected["ops/sweep.py"].count("kernel") >= 2
+    for helper in ("_assemble", "_hash", "_fold"):
+        assert helper in collected["ops/sweep.py"]
+    # ops/pallas_sha256.py: the pallas kernel body (pass 1 + pass 2 in
+    # one def) and the jit wrappers of both factories.
+    assert "kernel" in collected["ops/pallas_sha256.py"]
+    assert collected["ops/pallas_sha256.py"].count("minhash") >= 2
+
+
 # --------------------------------------------------------------------------
 # 2b. lockcheck --fix: the mechanical lock fixer (ISSUE 12 carry-over)
 # --------------------------------------------------------------------------

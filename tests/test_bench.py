@@ -62,6 +62,30 @@ def test_post_probe_wedge_still_emits_json():
     assert "hung" in out["error"]
 
 
+def test_sieve_compare_fast_leg():
+    """``--sieve-compare --fast`` (ISSUE 13): the tier-1 correctness leg
+    of the sieve-vs-baseline comparison — both kernels oracle-gated on a
+    digit-boundary range, the interpret-mode pallas sieve included, and
+    the JSON honest about which kernel auto_tune keeps: a losing sieve
+    must demonstrably keep the baseline."""
+    p = run_bench("--sieve-compare", "--fast", "--cpu")
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = p.stdout.strip().splitlines()
+    assert len(lines) == 1, lines
+    out = json.loads(lines[0])
+    assert out["metric"] == "sieve_compare"
+    assert out["bitexact"] is True
+    assert out["interpret_pallas_sieve_bitexact"] is True
+    assert out["baseline_nps"] > 0 and out["sieve_nps"] > 0
+    assert out["fast"] is True
+    # The honesty contract: on a shape where the sieve leg loses, the
+    # auto_tune rung must keep the baseline kernel (and vice versa the
+    # sieve default may only claim a shape where it does not lose).
+    if out["ratio"] < 1.0:
+        assert out["kept_kernel"] == "baseline"
+    assert out["auto_tune_sieve"] == (out["kept_kernel"] == "sieve")
+
+
 def test_cpu_bench_emits_one_valid_json_line():
     p = run_bench("--cpu")
     assert p.returncode == 0, p.stderr[-2000:]

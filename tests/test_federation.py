@@ -525,13 +525,66 @@ class TestRingSuccessor:
 def test_shed_vs_death_discrimination_drill():
     """The ISSUE 12 shed-vs-death acceptance: a peer forced into
     SHEDDING via admission flood stays routable and is never suspected
-    or marked down (fed.false_suspicions == 0)."""
+    or marked down (fed.false_suspicions == 0).  The seeded storm also
+    gates the ISSUE 13 flap-damping satellite: the peer-side load view
+    must not oscillate OK<->SHEDDING across gossip rounds."""
     METRICS.reset()
     report = fed_drill.drill_shed_storm(seed=1)
     assert report["ok"], report
     assert report["false_suspicions"] == 0
     assert report["liveness_during_storm"] == ALIVE
     assert not report["marked_down"] and report["still_routable"]
+    assert report["shed_flaps"] <= 1, report
+
+
+def test_shedding_hysteresis_holds_across_quiet_beats():
+    """Flap damping (ISSUE 13 satellite): a single shed makes the NEXT
+    beat SHEDDING, the state holds for shed_hold_beats evidence-free
+    beats, then reverts to OK — and fresh evidence re-arms the hold.
+    Without the hold, a storm shedding on alternate beat pairs flips the
+    fed.peer_state gauge every gossip round."""
+    METRICS.reset()
+    rep = Replica(
+        "hold",
+        {},
+        params=PARAMS,
+        scheduler=Scheduler(min_chunk=500),
+        gossip_interval=5.0,
+        shed_hold_beats=2,
+    )  # never start()ed: load_state is pure state-machine + gateway reads
+    try:
+        assert rep.load_state() == "OK"
+        rep.gateway.shed_count += 1  # one shed lands between beats
+        assert rep.load_state() == "SHEDDING"  # evidence beat
+        assert rep.load_state() == "SHEDDING"  # held (quiet beat 1)
+        assert rep.load_state() == "SHEDDING"  # held (quiet beat 2)
+        assert rep.load_state() == "OK"  # hysteresis satisfied
+        assert METRICS.get("fed.shed_holds") == 2
+        # Fresh evidence mid-hold re-arms the full window.
+        rep.gateway.shed_count += 1
+        assert rep.load_state() == "SHEDDING"
+        rep.gateway.shed_count += 1
+        assert rep.load_state() == "SHEDDING"  # evidence again, not a hold
+        assert rep.load_state() == "SHEDDING"
+        assert rep.load_state() == "SHEDDING"
+        assert rep.load_state() == "OK"
+        # shed_hold_beats=0 restores the point-in-time behavior.
+        rep2 = Replica(
+            "nohold",
+            {},
+            params=PARAMS,
+            scheduler=Scheduler(min_chunk=500),
+            gossip_interval=5.0,
+            shed_hold_beats=0,
+        )
+        try:
+            rep2.gateway.shed_count += 1
+            assert rep2.load_state() == "SHEDDING"
+            assert rep2.load_state() == "OK"
+        finally:
+            rep2.close()
+    finally:
+        rep.close()
 
 
 def test_death_detected_by_heartbeats_within_confirmation_window():

@@ -388,6 +388,168 @@ class TestDynKernel:
         assert len(zeros) == 1, "untouched words must share ONE zero tile"
 
 
+class TestSieve:
+    """The two-stage sieve kernel (ISSUE 13): pass-1 survivor predicate
+    ``h0 <= threshold`` + survivor-only pass-2 min-fold, on both backends.
+    The adversarial matrix: exact ``h0 == threshold`` ties (which must
+    conservatively survive), duplicate minimum hashes with the
+    lowest-nonce tie-break, digit-class boundaries (9→10, 99→100), and
+    the u64 upper edge — every case bit-exact vs the hashlib oracle."""
+
+    BACKENDS = [
+        ("xla", dict(backend="xla")),
+        ("pallas", dict(backend="pallas", interpret=True, batch=2)),
+    ]
+
+    @pytest.mark.parametrize("name,kw", BACKENDS, ids=[b[0] for b in BACKENDS])
+    @pytest.mark.parametrize(
+        "lo,hi",
+        [
+            (5, 15),       # 9→10: d=1 (static pallas fallback) + d=2
+            (93, 107),     # 99→100 digit-class boundary
+            (985, 1040),   # 999→1000 (the dyn-kernel window shift)
+        ],
+    )
+    def test_digit_class_boundaries(self, name, kw, lo, hi):
+        r = sweep_min_hash("cmu440", lo, hi, max_k=2, sieve=True, **kw)
+        assert (r.hash, r.nonce) == min_hash_range("cmu440", lo, hi)
+        assert r.lanes_swept == hi - lo + 1
+
+    @pytest.mark.parametrize("name,kw", BACKENDS, ids=[b[0] for b in BACKENDS])
+    def test_u64_upper_edge(self, name, kw):
+        top = (1 << 64) - 1
+        r = sweep_min_hash("big", top - 50, top, max_k=1, sieve=True, **kw)
+        assert (r.hash, r.nonce) == min_hash_range("big", top - 50, top)
+
+    def test_multi_dispatch_threshold_tightens_bit_exact(self):
+        # batch=2 at k=2 → many dispatches: later ones run against a
+        # tightened running-min threshold and mostly skip pass 2; the
+        # fold must stay bit-exact (cross-checked per-nonce below via
+        # digest_u64_py so the layout machinery itself is in the loop).
+        lo, hi = 100, 2099
+        r = sweep_min_hash(
+            "cmu440", lo, hi, backend="xla", max_k=2, batch=2, sieve=True
+        )
+        assert (r.hash, r.nonce) == min_hash_range("cmu440", lo, hi)
+        best = None
+        for n in range(lo, hi + 1):
+            digits = str(n)
+            layout = build_layout(b"cmu440", len(digits))
+            cand = (digest_u64_py(layout, digits), n)
+            if best is None or cand < best:
+                best = cand
+        assert (r.hash, r.nonce) == best
+
+    # ---------------------------------------------------- direct kernel calls
+
+    def _tie_setup(self):
+        """One chunk row of nonces [100, 199] for data 'tie' (d=3, k=2)
+        plus the oracle's (min h0, min h1, argmin lane) over it."""
+        import numpy as np
+
+        layout = build_layout(b"tie", 3)
+        h, n = min_hash_range("tie", 100, 199)
+        row = np.array(layout.tail_template, dtype=np.uint64)
+        dp = layout.digit_pos[0]
+        row[dp.word] |= np.uint64(ord("1") << dp.shift)
+        midstate = np.array(layout.midstate, dtype=np.uint32)
+        return layout, midstate, row, (h >> 32, h & 0xFFFFFFFF, n - 100)
+
+    def test_xla_threshold_tie_survives(self):
+        """``h0 == threshold`` exactly: the tie must survive pass 1 —
+        a strict predicate would lose a lane that still wins on (h1,
+        nonce)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bitcoin_miner_tpu.ops.sweep import make_kernel_body
+
+        layout, midstate, row, (eh0, eh1, elane) = self._tie_setup()
+        kern = make_kernel_body(
+            layout.n_tail_blocks, layout.digit_pos[1:], 2, batch=1,
+            rolled=True, sieve=True,
+        )
+        tail_const = row.astype(np.uint32)[None, :]
+        bounds = np.array([[0, 100]], dtype=np.int32)
+        h0, h1, idx = kern(
+            jnp.asarray(midstate), jnp.asarray(tail_const),
+            jnp.asarray(bounds), jnp.uint32(eh0),  # thresh == exact min h0
+        )
+        assert (int(h0), int(h1), int(idx)) == (eh0, eh1, elane)
+
+    def test_xla_threshold_below_min_prunes_everything(self):
+        """threshold strictly below the range's min h0: no survivor, the
+        I32_MAX sentinel comes back, and the host keeps its running best
+        — proves the sieve actually prunes rather than vacuously passing."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bitcoin_miner_tpu.ops.sweep import I32_MAX, make_kernel_body
+
+        layout, midstate, row, (eh0, _eh1, _elane) = self._tie_setup()
+        assert eh0 > 0, "degenerate oracle minimum"
+        kern = make_kernel_body(
+            layout.n_tail_blocks, layout.digit_pos[1:], 2, batch=1,
+            rolled=True, sieve=True,
+        )
+        tail_const = row.astype(np.uint32)[None, :]
+        bounds = np.array([[0, 100]], dtype=np.int32)
+        _h0, _h1, idx = kern(
+            jnp.asarray(midstate), jnp.asarray(tail_const),
+            jnp.asarray(bounds), jnp.uint32(eh0 - 1),
+        )
+        assert int(idx) == I32_MAX
+
+    def test_pallas_sieve_threshold_tie_survives(self):
+        """Same tie contract through the REAL prize path: the pallas
+        sieve kernel's SMEM threshold scratch + survivor-only pass 2."""
+        import numpy as np
+
+        from bitcoin_miner_tpu.ops.pallas_sha256 import make_pallas_minhash
+
+        layout, midstate, row, (eh0, eh1, elane) = self._tie_setup()
+        fn = make_pallas_minhash(
+            layout.n_tail_blocks, layout.digit_pos[1:], 2,
+            batch=1, interpret=True, sieve=True,
+        )
+        tailcb = np.concatenate([row, [0, 100]]).astype(np.uint32)[None, :]
+        thresh = np.array([eh0 ^ 0x80000000], dtype=np.uint32).view(np.int32)
+        h0, h1, idx = fn(midstate, tailcb, thresh)
+        assert (int(h0), int(h1), int(idx)) == (eh0, eh1, elane)
+        # And strictly below the min: everything pruned.
+        from bitcoin_miner_tpu.ops.sweep import I32_MAX
+
+        thresh = np.array([(eh0 - 1) ^ 0x80000000], dtype=np.uint32).view(
+            np.int32
+        )
+        _h0, _h1, idx = fn(midstate, tailcb, thresh)
+        assert int(idx) == I32_MAX
+
+    def test_pallas_sieve_duplicate_minimum_lowest_nonce(self):
+        """Duplicate rows covering the same range tie on (h0, h1)
+        everywhere; the sieve kernel's pass 2 must still resolve to the
+        lowest flat index → lowest nonce (same contract as the baseline
+        kernel's tie tests above)."""
+        import numpy as np
+
+        from bitcoin_miner_tpu.ops.pallas_sha256 import make_pallas_minhash
+
+        layout, midstate, row, (eh0, eh1, _elane) = self._tie_setup()
+        fn = make_pallas_minhash(
+            layout.n_tail_blocks, layout.digit_pos[1:], 2,
+            batch=2, cpb=2, interpret=True, sieve=True,
+        )
+        tailcb = np.tile(
+            np.concatenate([row, [0, 100]]).astype(np.uint32), (2, 1)
+        )
+        thresh = np.array([0xFFFFFFFF ^ 0x80000000], dtype=np.uint32).view(
+            np.int32
+        )  # loose: everything survives, both duplicate rows fold
+        h0, h1, idx = fn(midstate, tailcb, thresh)
+        assert (int(h0), int(h1)) == (eh0, eh1)
+        assert int(idx) < 100  # row 0, not the duplicate row 1
+
+
 class TestPipelineLifecycle:
     """SweepPipeline edge behavior: close/submit ordering and concurrent
     submitters — the states a miner hits at shutdown and under the

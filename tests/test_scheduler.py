@@ -294,21 +294,84 @@ class TestStealScan:
         s = Scheduler(
             validate_results=False, min_chunk=100, max_chunk=100,
             pipeline_depth=1, steal_min_seconds=0.0, steal_min_samples=4,
+            straggler_min_seconds=0.0,
         )
         s.miner_joined(1, now=0.0)
         # Exactly 5 chunks: after 4 completions the LAST chunk is the
         # front and the job has no pending work left for a joiner.
         s.client_request(10, "d", 0, 499, now=0.0)
-        # Build fleet evidence: 4 accepted chunks at ~0.1 s each.
+        # Build fleet evidence: 4 accepted chunks at ~0.1 s each
+        # (miner EWMA rate ~1000 nonces/s).
         for i in range(4):
             s.result(1, hash_=5, nonce=100 * i, now=0.1 * (i + 1))
         s.miner_joined(2, now=0.45)  # idle thief, nothing to dispatch
         # Miner 1's running chunk started at 0.4; at 0.5 it is younger
         # than steal_factor(2.0) x p50(0.1) -> no steal yet.
         assert s.tick(now=0.5) == []
-        acts = s.tick(now=0.7)  # age 0.3 > 0.2: tail re-dispatched
+        # Age evidence is in at 0.7, but the rate-aware cut point (ISSUE
+        # 13 satellite) says the straggler's ~1000 n/s EWMA finishes the
+        # remaining 100 nonces well before its re-queue deadline
+        # (0.4 + 4.0 x 0.1 = 0.8) -> stealing would be pure duplication.
+        assert s.tick(now=0.7) == []
+        # At 0.75 only ~50 nonces fit before the deadline: the
+        # unfinishable tail (and ONLY it) is re-dispatched to the thief.
+        acts = s.tick(now=0.75)
         assert [m.type for _, m in acts] == [MsgType.REQUEST]
         assert acts[0][0] == 2
+        msg = acts[0][1]
+        assert (msg.lower, msg.upper) == (450, 499)
+        assert s.miners[1].queue[0].stolen == (450, 499)
+
+    def test_rate_aware_cut_grows_as_deadline_nears(self):
+        """The satellite's core property: the stolen tail is exactly the
+        portion the straggler's EWMA rate cannot cover by its re-queue
+        deadline, so successive ticks (deadline approaching, nothing
+        answered) would steal strictly more."""
+        def fleet():
+            s = Scheduler(
+                validate_results=False, min_chunk=1000, max_chunk=1000,
+                pipeline_depth=1, steal_min_seconds=0.0,
+                steal_min_samples=1, straggler_min_seconds=0.0,
+            )
+            s.miner_joined(1, now=0.0)
+            s.client_request(10, "d", 0, 1999, now=0.0)
+            # One completed chunk: rate = 1000/1.0 = 1000 n/s, p50 = 1 s.
+            s.result(1, hash_=5, nonce=7, now=1.0)
+            s.miner_joined(2, now=1.0)
+            return s
+
+        # Chunk [1000, 1999] started at 1.0; re-queue deadline = 1.0 +
+        # 4.0 x (1000/1000) = 5.0.  At now=4.25 the straggler covers
+        # 1000 x 0.75 = 750 more nonces -> steal [1750, 1999].  (Times
+        # are binary-exact so int() truncation is deterministic.)
+        s = fleet()
+        acts = s.tick(now=4.25)
+        (thief, msg), = acts
+        assert thief == 2 and (msg.lower, msg.upper) == (1750, 1999)
+        # Closer to the deadline the unfinishable tail is larger: at
+        # now=4.75 only 250 nonces fit -> steal [1250, 1999].
+        s = fleet()
+        acts = s.tick(now=4.75)
+        (thief, msg), = acts
+        assert thief == 2 and (msg.lower, msg.upper) == (1250, 1999)
+
+    def test_marked_straggler_ignores_own_rate(self):
+        """An externally marked miner (fleet-detector leave-one-out
+        evidence) keeps the legacy half split even when its own EWMA
+        claims it finishes in time — the mark exists because that EWMA
+        is not trustworthy."""
+        s = Scheduler(
+            validate_results=False, min_chunk=1000, max_chunk=1000,
+            pipeline_depth=1, steal_min_seconds=0.0, steal_min_samples=64,
+        )
+        s.miner_joined(1, now=0.0)
+        s.client_request(10, "d", 0, 1999, now=0.0)
+        s.result(1, hash_=5, nonce=7, now=0.1)  # EWMA 10^4 n/s: "fast"
+        s.miner_joined(2, now=0.1)
+        s.mark_straggler(1)
+        acts = s.tick(now=0.2)
+        (thief, msg), = acts
+        assert thief == 2 and (msg.lower, msg.upper) == (1500, 1999)
 
     def test_cold_fleet_never_steals_on_guesses(self):
         s = self._one_chunk_fleet(steal_min_seconds=0.0)

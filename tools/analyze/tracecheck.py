@@ -9,9 +9,11 @@ inside **kernel bodies** — functions it identifies as jit-traced:
 - decorated with ``@jax.jit`` / ``@partial(jax.jit, ...)``,
 - passed by name to ``jax.jit(...)`` in the same module,
 - defined (at any nesting depth) inside a kernel factory — a function
-  whose name matches ``(make|build).*(kernel|minhash|call)``, the repo's
-  factory convention (``make_kernel_body``, ``_build_call``,
-  ``_make_sharded_kernel``, ...),
+  whose name matches ``(make|build).*(kernel|minhash|sieve|call)``, the
+  repo's factory convention (``make_kernel_body``, ``_build_call``,
+  ``_make_sharded_kernel``, and the ISSUE 13 sieve factories — both of
+  the two-stage sieve's passes live inside these bodies on both
+  backends, so the race/contract checks gate them like the old code),
 - or explicitly marked with ``# jit-kernel`` on its def line.
 
 Rules (suppress a deliberate line with ``# trace-ok: <reason>``):
@@ -59,7 +61,7 @@ from .common import (
 
 PASS = "trace"
 
-FACTORY_RE = re.compile(r"(make|build).*(kernel|minhash|call)")
+FACTORY_RE = re.compile(r"(make|build).*(kernel|minhash|sieve|call)")
 
 #: Default scan scope in repo mode: the accelerator layers.
 TRACE_SCAN_DIRS = (

@@ -77,7 +77,9 @@ MAX_K = 6  # explicit: the measurement premise below depends on it
 from bitcoin_miner_tpu.ops.pallas_sha256 import DEFAULT_CPB as CPB  # noqa: E402
 
 # Per-nonce VPU ops of the kernel OUTSIDE compress, hand-counted from
-# ops/pallas_sha256.py's kernel body (per row-visit per lane):
+# ops/pallas_sha256.py's kernel body (per row-visit per lane).  This is
+# the BASELINE kernel's reduction epilogue — and the sieve kernel's
+# pass-2 (survivor-groups-only) epilogue, which is the same full fold:
 #   valid mask        2 cmp + 1 and                  = 3
 #   h0/h1 select      2 where                        = 2
 #   sign-flip         2 xor (bitcast is layout-free) = 2
@@ -88,6 +90,19 @@ from bitcoin_miner_tpu.ops.pallas_sha256 import DEFAULT_CPB as CPB  # noqa: E402
 #   lane index i      ~5 (2 iota + mul + 2 add)      = 5 / CPB
 #   accumulator RMW   12                             = 12 / CPB
 EPILOGUE_OPS = 3 + 2 + 2 + 2 + 12 * (CPB - 1) / CPB + (5 + 12) / CPB
+
+# The sieve kernel's PASS-1 epilogue (ISSUE 13) — the survivor predicate
+# that replaces all of the above on non-survivor groups, hand-counted
+# from the sieve branch of ops/pallas_sha256.py's kernel body:
+#   valid mask        2 cmp + 1 and                  = 3
+#   h0 select         1 where (no h1 chain at all)   = 1
+#   sign-flip         1 xor                          = 1
+#   predicate         1 cmp (h0b <= th)              = 1
+#   OR-accumulate     1 or, skipped on the first row = 1 * (CPB-1)/CPB
+# amortised once per program over cpb rows:
+#   lane index i      ~5                             = 5 / CPB
+#   any(surv) reduce  ~1 (one cross-lane reduce)     = 1 / CPB
+SIEVE_PASS1_EPILOGUE = 3 + 1 + 1 + 1 + 1 * (CPB - 1) / CPB + (5 + 1) / CPB
 
 
 class _Tr:
@@ -117,12 +132,14 @@ for _name in ("lshift", "rshift"):
     setattr(_Tr, f"__{_name}__", lambda self, o: _op(self, o))
 
 
-def count_vector_ops(data: str, d: int, k: int) -> int:
+def count_vector_ops(data: str, d: int, k: int, h0_only: bool = False) -> int:
     """Exact VPU op count per nonce for one full tail hash of ``data`` at
     digit count ``d`` with ``k`` in-kernel digits: the contrib-word ORs of
     the kernel's w assembly plus every vector op inside each block's
-    `compress` (final block in final_only form), threading the state's
-    vectorness across blocks exactly as the kernel does.
+    `compress` (final block in final_only form — or its ``"h0"``
+    output-mask form with ``h0_only=True``, the sieve kernel's pass 1),
+    threading the state's vectorness across blocks exactly as the kernel
+    does.
 
     Vector words mirror the PRODUCTION (digit-position-dynamic) kernel:
     every word of the dyn window is a vector (OR with a runtime contrib
@@ -149,9 +166,42 @@ def count_vector_ops(data: str, d: int, k: int) -> int:
             else:
                 w.append(_Tr(False))
         _COUNT[0] = 0
-        state = compress(state, w, final_only=(b == layout.n_tail_blocks - 1))
+        last = b == layout.n_tail_blocks - 1
+        fo = ("h0" if h0_only else True) if last else False
+        state = compress(state, w, final_only=fo)
         total += _COUNT[0]
     return total
+
+
+def sieve_op_report(data: str, d: int, k: int) -> dict:
+    """Per-pass op accounting for the two-stage sieve kernel (ISSUE 13),
+    so its claimed savings are auditable without TPU time:
+
+    - ``pass1`` = h0-only compression + the survivor-predicate epilogue —
+      what EVERY lane pays;
+    - ``pass2`` = the full (h0, h1) compression + the argmin-bookkeeping
+      epilogue — what lanes in SURVIVOR groups pay *again* (a vanishing
+      fraction once the running min tightens: its h0 falls like
+      U32_MAX / nonces_swept);
+    - ``baseline`` = the current kernel (full compression + bookkeeping
+      on 100% of lanes), for the steady-state comparison.
+    """
+    full = count_vector_ops(data, d, k)
+    h0 = count_vector_ops(data, d, k, h0_only=True)
+    baseline = full + EPILOGUE_OPS
+    pass1 = h0 + SIEVE_PASS1_EPILOGUE
+    pass2 = full + EPILOGUE_OPS
+    return {
+        "compress_full_ops": full,
+        "compress_h0_ops": h0,
+        "baseline_epilogue_ops": round(EPILOGUE_OPS, 2),
+        "sieve_pass1_epilogue_ops": round(SIEVE_PASS1_EPILOGUE, 2),
+        "baseline_ops_per_lane": round(baseline, 2),
+        "sieve_pass1_ops_per_lane": round(pass1, 2),
+        "sieve_pass2_ops_per_lane": round(pass2, 2),
+        # Steady state (survivor fraction -> 0): pass 1 is the whole cost.
+        "sieve_steady_state_savings": round(1 - pass1 / baseline, 4),
+    }
 
 
 def _rate(data: str, n: int) -> float:
@@ -167,6 +217,39 @@ def _rate(data: str, n: int) -> float:
 
 
 def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--ops-only",
+        action="store_true",
+        help="print only the static per-pass op accounting for the sieve "
+        "kernel (no device measurement — runs anywhere, incl. CI)",
+    )
+    args = ap.parse_args()
+
+    if args.ops_only:
+        rep = sieve_op_report(DATA_1BLK, 10, MAX_K)
+        rep2 = sieve_op_report(DATA_2BLK, 10, MAX_K)
+        print(
+            f"sieve op accounting ({DATA_1BLK!r}, d=10, k={MAX_K}): pass 1 "
+            f"{rep['sieve_pass1_ops_per_lane']} ops/lane vs baseline "
+            f"{rep['baseline_ops_per_lane']} -> steady-state savings "
+            f"{rep['sieve_steady_state_savings']:.1%} (pass 2 on survivor "
+            f"groups: {rep['sieve_pass2_ops_per_lane']} more)",
+            file=sys.stderr,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "sieve_op_report",
+                    "shape_1blk": {"data": DATA_1BLK, "d": 10, "k": MAX_K, **rep},
+                    "shape_2blk": {"data": DATA_2BLK, "d": 10, "k": MAX_K, **rep2},
+                }
+            )
+        )
+        return 0
+
     import jax
 
     from bitcoin_miner_tpu.ops.sha256 import build_layout
@@ -216,6 +299,9 @@ def main() -> int:
                 "ceiling_1blk": round(ceiling),
                 "headroom": round(headroom, 4),
                 "device_kind": kind,
+                # Per-pass sieve accounting for the flagship shape: what
+                # the measured rate's op model becomes with the sieve on.
+                "sieve": sieve_op_report(DATA_1BLK, 10, MAX_K),
             }
         )
     )
