@@ -65,7 +65,13 @@ Design notes (deliberately not a translation of anything):
   miner, the next sweep's dispatches enqueue while the current computes
   and the latency vanishes.  Rate samples use the result-to-result gap
   (``started_at`` promotes on pop), not assignment time, so pipelined
-  EWMA measures true device rate.
+  EWMA measures true device rate.  **Adaptive depth** (ISSUE 14
+  satellite, ``adaptive_depth=True``): the window is re-sized each tick
+  from the observed per-dispatch latency (``hist.device_dispatch_s``
+  p50) — ``1 + ceil(p50 / target_chunk_seconds)`` clamped to ``[1,
+  depth_cap]`` — so a low-latency fleet runs a SHALLOWER window (which
+  also keeps miners' enqueue-time sieve thresholds fresher) and a
+  high-latency tunnel deepens past the static 2 to stay busy.
 - **Result validation.** Every Result is re-checked with one hashlib call
   (``hash_nonce(data, nonce) == hash`` and nonce within the assigned
   interval) before folding — a lying or bit-flipping miner tier cannot
@@ -226,6 +232,10 @@ class Scheduler:
         steal_min_seconds: float = 2.0,
         steal_min_samples: int = 4,
         pipeline_depth: int = 2,
+        adaptive_depth: bool = False,
+        depth_cap: int = 4,
+        depth_min_samples: int = 8,
+        dispatch_latency=None,
         ramp_factor: int = 8,
         orphan_cache_max: int = 256,
         record_spans: bool = False,
@@ -267,6 +277,29 @@ class Scheduler:
         self._recent_chunk_s: Deque[float] = deque(maxlen=64)
         self._marked_stragglers: set = set()  # external (fleet-plane) naming
         self.pipeline_depth = pipeline_depth
+        # Adaptive pipeline depth (ISSUE 14 satellite, PR-10 carry-over):
+        # with adaptive_depth on, tick() re-sizes the per-miner assignment
+        # window off the observed per-dispatch device latency
+        # (hist.device_dispatch_s p50 by default; ``dispatch_latency`` is
+        # an injectable () -> seconds-or-None provider so pure scheduler
+        # tests — and servers reading a merged fleet view instead of the
+        # process registry — stay deterministic).  Depth covers the
+        # latency: 1 + ceil(p50 / target_chunk_seconds), clamped to
+        # [1, depth_cap]; no evidence (< depth_min_samples dispatches)
+        # keeps the configured static depth.  Besides hiding latency,
+        # shrinking the window when latency doesn't warrant it TIGHTENS
+        # sieve-threshold freshness: fewer in-flight chunks means the
+        # running-min h0 a miner enqueues with is staler by less
+        # (ROADMAP sieve follow-on 2).
+        self.adaptive_depth = adaptive_depth
+        self.depth_cap = max(1, depth_cap)
+        self.depth_min_samples = max(1, depth_min_samples)
+        self._dispatch_latency = (
+            self._metrics_dispatch_latency
+            if dispatch_latency is None
+            else dispatch_latency
+        )
+        self._eff_depth = pipeline_depth
         self.ramp_factor = ramp_factor
         self.orphan_cache_max = orphan_cache_max
         # Span export (ISSUE 5): with record_spans on, every accepted chunk
@@ -538,12 +571,55 @@ class Scheduler:
                     self._resume.pop(next(iter(self._resume)))
         return []
 
+    def _metrics_dispatch_latency(self):
+        """Default adaptive-depth evidence: the process registry's
+        per-dispatch enqueue→fetch p50 (observed by SweepPipeline's
+        fetcher — in-process fleets and single-process miners share the
+        registry; a distributed server injects a fleet-view reader via
+        ``dispatch_latency=`` instead)."""
+        h = METRICS.histogram("hist.device_dispatch_s")
+        if h is None or h.count() < self.depth_min_samples:
+            return None
+        return h.quantile(0.5)
+
+    def effective_depth(self) -> int:
+        """The assignment window actually in force (== ``pipeline_depth``
+        until adaptive evidence says otherwise)."""
+        return self._eff_depth if self.adaptive_depth else self.pipeline_depth
+
+    def _update_depth(self) -> bool:
+        """Re-size the assignment window off the latency evidence; True
+        when the window GREW (new idle capacity → the tick should
+        dispatch into it, like a reclaim)."""
+        lat = self._dispatch_latency()
+        if lat is None:
+            depth = self.pipeline_depth
+        else:
+            depth = min(
+                self.depth_cap,
+                1 + math.ceil(lat / max(self.target_chunk_seconds, 1e-6)),
+            )
+        depth = max(1, depth)
+        grew = depth > self._eff_depth
+        if depth != self._eff_depth:
+            METRICS.inc("sched.depth_adapt")
+            if _trace.enabled():
+                _trace.emit(
+                    None, "sched", "depth_adapt",
+                    depth=depth, was=self._eff_depth,
+                    latency_s=None if lat is None else round(lat, 6),
+                )
+            self._eff_depth = depth
+        return grew
+
     def tick(self, now: float) -> List[Action]:
         """Periodic straggler scan: re-queue chunks held far past their
         expected duration by a live-but-hung miner.  First Result wins —
         the loser's late Result just withdraws the duplicate and idles it.
         """
-        reclaimed = False
+        # A grown window is idle capacity: dispatch into it below, same
+        # as reclaimed work.
+        reclaimed = self._update_depth() if self.adaptive_depth else False
         for miner in self.miners.values():
             # Only the first non-timed-out assignment is "running"; later
             # queue entries haven't started (FIFO miner).  Timed-out flags
@@ -964,7 +1040,7 @@ class Scheduler:
         # work per assignment.  Miners with validation strikes sort last —
         # a re-queued chunk should land on a trustworthy peer, not bounce
         # back to the liar.
-        for level in range(self.pipeline_depth):
+        for level in range(self.effective_depth()):
             # A miner holding a timed-out (straggler-reclaimed) or
             # steal-flagged chunk is presumed hung/slow: no new work until
             # it answers or dies — otherwise its own re-queued duplicate

@@ -432,6 +432,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     static_chunks = os.environ.get("BMT_STATIC_CHUNKS") or None
     steal_factor = os.environ.get("BMT_STEAL_FACTOR") or None
     prefill = os.environ.get("BMT_PREFILL") or None
+    # --adaptive-depth (ISSUE 14 satellite): re-size the per-miner
+    # pipelined assignment window each tick off the observed dispatch
+    # latency (hist.device_dispatch_s p50) instead of the static 2.
+    adaptive_depth = bool(os.environ.get("BMT_ADAPTIVE_DEPTH"))
     pos = []
     for a in argv[1:]:
         if a.startswith("--checkpoint="):
@@ -444,6 +448,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             steal_factor = a.split("=", 1)[1]
         elif a.startswith("--prefill="):
             prefill = a.split("=", 1)[1]
+        elif a == "--adaptive-depth":
+            adaptive_depth = True
         elif a.startswith("--trace="):
             trace_path = a.split("=", 1)[1]
         elif a.startswith("--telemetry-port="):
@@ -540,6 +546,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 min_chunk=n, max_chunk=n,
                 adaptive_chunks=False, steal_factor=0.0,
             )
+        if adaptive_depth:
+            sched_kw["adaptive_depth"] = True
         prefill_n = int(prefill) if prefill is not None else 0
     except ValueError as e:
         print("Invalid scheduler configuration:", e)

@@ -39,7 +39,13 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .sha256 import DigitPos, compress, compress_rolled
+from .sha256 import (
+    DigitPos,
+    compress,
+    compress_rolled,
+    factor_low_pos,
+    outer_patch_table,
+)
 
 U32_MAX = 0xFFFFFFFF
 I32_MAX = 0x7FFFFFFF
@@ -525,6 +531,377 @@ def make_pallas_minhash_dyn(
         return _unflip(*call(midstate, tailc_bounds.reshape(-1), *contribs))
 
     return minhash, n_pad
+
+
+def _build_factored_call(
+    n_tail_blocks: int,
+    owords: Tuple[int, ...],
+    in_cwords: Tuple[int, ...],
+    first_inner_word: int,
+    k: int,
+    k_in: int,
+    batch: int,
+    tile: int,
+    interpret: bool,
+    cpb: Optional[int],
+    sieve: bool,
+):
+    """Build the pallas_call of the FACTORED kernel (ISSUE 14): the lane
+    axis ``10^k`` split into ``10^(k - k_in)`` outer digit groups (a new
+    sequential grid axis) × ``10^k_in`` inner lanes (the iota/tile axis).
+
+    Per (chunk-row, outer-group) visit the kernel patches the group's
+    outer-digit ASCII into the template with pure scalar ORs from the
+    ``outer_tab`` SMEM operand, computes the **per-group scalar round
+    prefix** — every tail block before ``first_inner_word`` plus that
+    block's leading rounds, entirely on the scalar unit via ``compress``'s
+    ``stop_round=`` entry point — and resumes the vector rounds from the
+    carried ``group_state`` at the first inner-digit word.  Only the
+    ``k_in`` inner digits ride VMEM contribution tiles, so every word the
+    baseline dyn kernel streamed as a window vector (and every compress /
+    σ-schedule chain it fed) stays on the scalar unit: 3002 → 2910 folded
+    vector ops/lane on the flagship 1-block shape (tools/roofline.py
+    ``--ops-only`` audits any shape).
+
+    ``sieve=True`` composes the PR-13 two-stage sieve: pass 1 hashes
+    h0-only **resuming from the same per-group prefix pass 2 uses** (the
+    group-prefix reuse), the survivor predicate/threshold scratch
+    semantics are unchanged, and the threshold now tightens across BOTH
+    sequential axes (chunk-row groups and outer digit groups).
+
+    Returns ``(call, n_pad)``; n_pad is the padded INNER lane count.
+    """
+    n_lanes = 10**k
+    s_in = 10**k_in
+    g_count = 10 ** (k - k_in)
+    if batch * n_lanes > I32_MAX:
+        # Same int32 flat-argmin guard as _build_call: the factored index
+        # remaps to chunk_row * 10^k + og * 10^k_in + lane.
+        raise ValueError(
+            f"batch ({batch}) * 10^k ({n_lanes}) lanes overflow the int32 "
+            "argmin index; lower batch or max_k"
+        )
+    tile = max(1024, min(tile, math.ceil(s_in / 1024) * 1024))
+    n_tiles = math.ceil(s_in / tile)
+    n_pad = n_tiles * tile
+    sub = tile // 128
+    word_to_cidx = {w: m for m, w in enumerate(in_cwords)}
+    ow_idx = {w: m for m, w in enumerate(owords)}
+    n_ow = len(owords)
+
+    n_words = n_tail_blocks * 16
+    row_w = n_words + 2
+    if cpb is None:
+        cpb = next(
+            c for c in range(min(DEFAULT_CPB, batch), 0, -1) if batch % c == 0
+        )
+    elif cpb < 1 or batch % cpb:
+        raise ValueError(f"cpb ({cpb}) must divide batch ({batch})")
+    groups = batch // cpb
+    fib, prefix_rounds = divmod(first_inner_word, 16)
+
+    def kernel(midstate_ref, tailc_ref, *rest):
+        thresh_ref = None
+        if sieve:
+            thresh_ref, rest = rest[0], rest[1:]
+        otab_ref, rest = rest[0], rest[1:]
+        contrib_refs = rest[: len(in_cwords)]
+        th_ref = None
+        if sieve:
+            (
+                h0_ref, h1_ref, idx_ref, a0_ref, a1_ref, ai_ref, th_ref,
+            ) = rest[len(in_cwords) :]
+        else:
+            h0_ref, h1_ref, idx_ref, a0_ref, a1_ref, ai_ref = rest[
+                len(in_cwords) :
+            ]
+        c = pl.program_id(0)  # chunk-row group (cpb rows each)
+        og = pl.program_id(1)  # outer digit group — sequential, like c/t
+        t = pl.program_id(2)  # inner lane tile
+        rows = [c * cpb + j for j in range(cpb)]
+        offs = [r * row_w for r in rows]
+        los = [tailc_ref[o + n_words].astype(jnp.int32) for o in offs]
+        his = [tailc_ref[o + n_words + 1].astype(jnp.int32) for o in offs]
+        # Per-group lane bounds (scalar clips): clipping the chunk bounds
+        # into [0, s_in) both rebases them onto the inner iota and masks
+        # every lane of a group the chunk's [lo, hi) doesn't reach —
+        # padding lanes i >= s_in are masked for free since ghi <= s_in.
+        glo = [jnp.clip(lo - og * s_in, 0, s_in) for lo in los]
+        ghi = [jnp.clip(hi - og * s_in, 0, s_in) for hi in his]
+
+        @pl.when((c == 0) & (og == 0) & (t == 0))
+        def _init():
+            empty = jnp.full((sub, 128), I32_MAX, dtype=jnp.int32)
+            a0_ref[...] = empty
+            a1_ref[...] = empty
+            ai_ref[...] = empty
+            if sieve:
+                th_ref[0] = thresh_ref[0]
+
+        any_work = ghi[0] > glo[0]
+        for j in range(1, cpb):
+            any_work = any_work | (ghi[j] > glo[j])
+
+        @pl.when(any_work)
+        def _work():
+            row = jax.lax.broadcasted_iota(jnp.int32, (sub, 128), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (sub, 128), 1)
+            i = t * tile + row * 128 + col  # INNER lane index
+            sbit = jnp.uint32(0x80000000)
+            if interpret:
+                from .sha256 import K
+
+                k_table = jnp.stack([jnp.uint32(int(v)) for v in K])
+
+            def comp(state, w, final_only=False, stop_round=None, group_state=None):
+                # Mosaic wants the unrolled rounds; interpret mode rolls
+                # them (same rationale as _build_call's _row_state).
+                if interpret:
+                    return compress_rolled(
+                        state, w, k_table=k_table, final_only=final_only,
+                        stop_round=stop_round, group_state=group_state,
+                    )
+                return compress(
+                    state, w, final_only=final_only,
+                    stop_round=stop_round, group_state=group_state,
+                )
+
+            def _row_blocks(j):
+                """Row j's w words for outer group og: template scalars,
+                outer digits OR-patched as per-group SMEM scalars, inner
+                digits as VMEM contribution tiles."""
+                blocks = []
+                for blk in range(n_tail_blocks):
+                    w = []
+                    for widx in range(blk * 16, (blk + 1) * 16):
+                        base = tailc_ref[offs[j] + widx]
+                        if widx in ow_idx:
+                            base = base | otab_ref[og * n_ow + ow_idx[widx]]
+                        if widx in word_to_cidx:
+                            w.append(
+                                contrib_refs[word_to_cidx[widx]][...] | base
+                            )
+                        else:
+                            w.append(base)
+                    blocks.append(w)
+                return blocks
+
+            def _row_prefix(blocks):
+                """The per-group scalar round prefix (computed once per
+                row-group visit, shared by pass 1 AND pass 2): blocks
+                before the first inner word run whole on the scalar unit,
+                and that block's leading rounds stop at the carried
+                group_state."""
+                state = tuple(midstate_ref[s] for s in range(8))
+                for b in range(fib):
+                    state = comp(state, blocks[b])
+                return state, comp(state, blocks[fib], stop_round=prefix_rounds)
+
+            def _row_state(pre, final_form):
+                """Vector rounds of one row: resume block fib from the
+                carried group state, then any remaining blocks."""
+                blocks, state_fib, gs = pre
+                st = state_fib
+                for b in range(fib, n_tail_blocks):
+                    fo = final_form if b == n_tail_blocks - 1 else False
+                    if b == fib:
+                        st = comp(st, blocks[b], final_only=fo, group_state=gs)
+                    else:
+                        st = comp(st, blocks[b], final_only=fo)
+                return st
+
+            pres = []
+            for j in range(cpb):
+                blocks = _row_blocks(j)
+                state_fib, gs = _row_prefix(blocks)
+                pres.append((blocks, state_fib, gs))
+
+            def _full_fold():
+                """The full (h0, h1) lexicographic min-fold + accumulator
+                read-modify-write — identical bookkeeping to the baseline
+                kernel's, at the inner-lane tile shape."""
+                l0 = l1 = li = None
+                for j in range(cpb):
+                    state = _row_state(pres[j], True)
+                    valid = (i >= glo[j]) & (i < ghi[j])
+                    h0 = jnp.where(valid, state[0], jnp.uint32(U32_MAX))
+                    h1 = jnp.where(valid, state[1], jnp.uint32(U32_MAX))
+                    h0b = jax.lax.bitcast_convert_type(h0 ^ sbit, jnp.int32)
+                    h1b = jax.lax.bitcast_convert_type(h1 ^ sbit, jnp.int32)
+                    # Global flat index: scalar base + inner lane (the
+                    # scalar part folds off the VPU like the baseline's
+                    # rows[j] * n_lanes term).
+                    base_j = rows[j] * n_lanes + og * s_in
+                    idx = jnp.where(valid, base_j + i, jnp.int32(I32_MAX))
+                    if l0 is None:
+                        l0, l1, li = h0b, h1b, idx
+                    else:
+                        better = (h0b < l0) | (
+                            (h0b == l0)
+                            & ((h1b < l1) | ((h1b == l1) & (idx < li)))
+                        )
+                        l0 = jnp.where(better, h0b, l0)
+                        l1 = jnp.where(better, h1b, l1)
+                        li = jnp.where(better, idx, li)
+
+                p0 = a0_ref[...]
+                p1 = a1_ref[...]
+                pi = ai_ref[...]
+                better = (l0 < p0) | (
+                    (l0 == p0) & ((l1 < p1) | ((l1 == p1) & (li < pi)))
+                )
+                a0_ref[...] = jnp.where(better, l0, p0)
+                a1_ref[...] = jnp.where(better, l1, p1)
+                ai_ref[...] = jnp.where(better, li, pi)
+
+            if not sieve:
+                _full_fold()
+            else:
+                # Pass 1: h0-only, resuming from the SAME per-group
+                # prefix pass 2 reuses below.
+                th = th_ref[0]
+                surv = None
+                for j in range(cpb):
+                    (h0,) = _row_state(pres[j], "h0")
+                    h0 = jnp.where(
+                        (i >= glo[j]) & (i < ghi[j]), h0, jnp.uint32(U32_MAX)
+                    )
+                    h0b = jax.lax.bitcast_convert_type(h0 ^ sbit, jnp.int32)
+                    # <= not <: conservative tie survival (ISSUE 13).
+                    s = h0b <= th
+                    surv = s if surv is None else (surv | s)
+
+                @pl.when(jnp.any(surv))
+                def _survivors():
+                    _full_fold()
+                    th_ref[0] = jnp.minimum(th_ref[0], jnp.min(a0_ref[...]))
+
+        @pl.when((c == groups - 1) & (og == g_count - 1) & (t == n_tiles - 1))
+        def _final():
+            v0 = a0_ref[...]
+            v1 = a1_ref[...]
+            vi = ai_ref[...]
+            m0 = jnp.min(v0)
+            e0 = v0 == m0
+            m1 = jnp.min(jnp.where(e0, v1, jnp.int32(I32_MAX)))
+            e1 = e0 & (v1 == m1)
+            mi = jnp.min(jnp.where(e1, vi, jnp.int32(I32_MAX)))
+            h0_ref[0] = m0
+            h1_ref[0] = m1
+            idx_ref[0] = mi
+
+    grid = (groups, g_count, n_tiles)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # midstate (8,)
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # tail_const+bounds, flat
+    ]
+    if sieve:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # thresh (1,)
+    # Per-group outer-digit patch table, flat (10^k_out * n_ow,): tiny
+    # (<= ~8 KB at k_out=3) next to the chunk table's ~147 KB.
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    in_specs += [
+        pl.BlockSpec(
+            (sub, 128), lambda c, og, t: (t, 0), memory_space=pltpu.VMEM
+        )
+        for _ in in_cwords
+    ]
+    out_specs = [pl.BlockSpec(memory_space=pltpu.SMEM) for _ in range(3)]
+    out_shape = [
+        jax.ShapeDtypeStruct((1,), jnp.int32),  # sign-flipped h0
+        jax.ShapeDtypeStruct((1,), jnp.int32),  # sign-flipped h1
+        jax.ShapeDtypeStruct((1,), jnp.int32),
+    ]
+    scratch = [pltpu.VMEM((sub, 128), jnp.int32) for _ in range(3)]
+    if sieve:
+        scratch.append(pltpu.SMEM((1,), jnp.int32))
+
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )
+    return call, n_pad
+
+
+@functools.lru_cache(maxsize=256)
+def make_pallas_minhash_factored(
+    n_tail_blocks: int,
+    low_pos: Tuple[DigitPos, ...],
+    k: int,
+    k_in: int,
+    batch: int = DEFAULT_BATCH,
+    tile: int = DEFAULT_TILE,
+    interpret: bool = False,
+    cpb: Optional[int] = None,
+    sieve: bool = False,
+):
+    """Build the jitted FACTORED Pallas min-hash for one (layout, k,
+    batch) class (ISSUE 14) — per-class STATIC (see ops/sweep.py
+    ``_build_kernel`` for why the dyn window can't factor).
+
+    Same calling convention and output contract as
+    :func:`make_pallas_minhash`: ``(midstate (8,), tailc_bounds (B,
+    nw+2))`` — plus ``thresh (1,) int32`` first among the extras when
+    ``sieve=True`` — returning ``(min_h0, min_h1, flat_idx)`` with
+    ``flat_idx = chunk_row * 10^k + lane_in_chunk`` (the outer/inner
+    remap happens in-kernel), I32_MAX when masked out or nothing
+    survived the threshold.  The outer-digit patch table and the inner
+    contribution tiles are trace constants of the jit wrapper.
+    """
+    split = factor_low_pos(low_pos, k_in)
+    owords, otab_np = outer_patch_table(split.outer_pos)
+    in_cwords = _contrib_words(split.inner_pos)
+    call, n_pad = _build_factored_call(
+        n_tail_blocks,
+        owords,
+        in_cwords,
+        split.first_inner_word,
+        k,
+        k_in,
+        batch,
+        tile,
+        interpret,
+        cpb,
+        sieve,
+    )
+    otab_flat = otab_np.reshape(-1)
+    inner_pos = split.inner_pos
+
+    if sieve:
+
+        @jax.jit
+        def minhash(midstate, tailc_bounds, thresh):
+            contribs = tuple(
+                jnp.asarray(c)
+                for c in _digit_contrib_np(k_in, inner_pos, n_pad)
+            )
+            return _unflip(
+                *call(
+                    midstate, tailc_bounds.reshape(-1), thresh,
+                    jnp.asarray(otab_flat), *contribs,
+                )
+            )
+
+        return minhash
+
+    @jax.jit
+    def minhash(midstate, tailc_bounds):
+        contribs = tuple(
+            jnp.asarray(c) for c in _digit_contrib_np(k_in, inner_pos, n_pad)
+        )
+        return _unflip(
+            *call(
+                midstate, tailc_bounds.reshape(-1),
+                jnp.asarray(otab_flat), *contribs,
+            )
+        )
+
+    return minhash
 
 
 @functools.lru_cache(maxsize=8)

@@ -26,6 +26,7 @@ template host-side (see ops/sweep.py).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import List, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -66,7 +67,11 @@ def _rotr(x, n: int):
 
 
 def compress(
-    state: Sequence, w: Sequence, final_only: "bool | str" = False
+    state: Sequence,
+    w: Sequence,
+    final_only: "bool | str" = False,
+    stop_round: "int | None" = None,
+    group_state: "Tuple | None" = None,
 ) -> Tuple:
     """One SHA-256 compression of a 16-word block.
 
@@ -74,6 +79,21 @@ def compress(
     arrays of the message block.  Returns the 8 updated state arrays.  The
     64 rounds are unrolled in Python so XLA sees one straight-line
     elementwise DAG it can fuse and software-pipeline on the VPU.
+
+    ``stop_round=p`` / ``group_state=`` are the factored-nonce entry
+    points (ISSUE 14).  ``stop_round=p`` (0 <= p <= 16) runs only rounds
+    ``[0, p)`` — which consume just ``w[0:p]``, so callers may pass the
+    block's leading words alone — and returns the carried mid-round
+    **group state** ``(p, (a..h))``: for a factored chunk whose high
+    "outer" lane digits are per-group constants, every round before the
+    first inner-digit word is group-invariant, so the caller computes
+    this prefix ONCE per group on the scalar unit.  ``group_state=``
+    resumes a compression from such a carried state: rounds ``[p, 64)``
+    run normally (the maj cross-round carry is rebuilt from the resumed
+    state's ``b ^ c`` — one scalar op), and ``state`` must still be the
+    block's INITIAL state for the final feed-forward additions.  The
+    composition ``compress(s, w, group_state=compress(s, w,
+    stop_round=p))`` is bit-identical to ``compress(s, w)`` for any p.
 
     ``final_only=True`` (for a message's LAST block when only the first 8
     digest bytes matter — the mining contract reads exactly ``(h0, h1)``,
@@ -101,21 +121,40 @@ def compress(
     rounds consuming only constant words run entirely off the VPU, K[t]
     folds into constant wt for free, and σ0/σ1 of constant schedule words
     never hit the vector lanes.  Exact folded counts on the flagship
-    shape ('cmu440', d=10, k=6; tools/roofline.py, r13): 3002 vector ops
+    shape ('cmu440', d=10, k=6; tools/roofline.py, r14): 3002 vector ops
     per lane for the full final_only compression (3001 in the sieve's
     "h0" output-mask form) + a 21.6-op reduction epilogue for the
     baseline kernel vs 7.6 for the sieve's pass-1 survivor predicate —
-    the compression dominates, which is why the sieve's steady-state
-    op-model gain on this shape is ~0.5%, all of it epilogue.
+    the compression dominates (~3002 of ~3024 ops), which is why the
+    sieve's steady-state op-model gain on this shape is ~0.5%, all of it
+    epilogue, and why ISSUE 14 attacks the compression itself: the
+    FACTORED kernel's inner-word-only vector set (outer digits patched
+    as per-group scalars via ``stop_round=``/``group_state=``, only the
+    k_in inner digit words vector) drops the same shape to 2910 full /
+    2909 "h0" ops per lane — factored sieve pass 1 at 2916.6 ops/lane vs
+    the unfactored 3008.6 (`tools/roofline.py --ops-only` audits both).
     """
-    a, b, c, d, e, f, g, h = state
+    if group_state is None:
+        start = 0
+        a, b, c, d, e, f, g, h = state
+    else:
+        start, mid = group_state
+        a, b, c, d, e, f, g, h = mid
+    if stop_round is not None and not start <= stop_round <= 16:
+        # Past round 16 the rotating schedule buffer has been written and
+        # the carried state would no longer be (round, 8 words).
+        raise ValueError(f"stop_round must be in [{start}, 16], got {stop_round}")
     w = list(w)
     # maj cross-round reuse: b_t ^ c_t == a_{t-1} ^ b_{t-1} (the state
     # shuffle renames, it doesn't recompute), so each round's (b^c) is last
     # round's (a^b) — carried in prev_xab.  Saves 1 op/round vs the 4-op
-    # form; spelled explicitly rather than trusting commutative CSE.
+    # form; spelled explicitly rather than trusting commutative CSE.  On a
+    # group_state resume this identity also REBUILDS the carry: the resumed
+    # state's (b ^ c) is exactly the suspended round's prev_xab.
     prev_xab = b ^ c
-    for t in range(64):
+    for t in range(start, 64):
+        if t == stop_round:
+            return (t, (a, b, c, d, e, f, g, h))
         if t < 16:
             wt = w[t]
         else:
@@ -153,34 +192,41 @@ def compress(
 
 
 def compress_rolled(
-    state: Sequence, w: Sequence, k_table=None, final_only: "bool | str" = False
+    state: Sequence,
+    w: Sequence,
+    k_table=None,
+    final_only: "bool | str" = False,
+    stop_round: "int | None" = None,
+    group_state: "Tuple | None" = None,
 ) -> Tuple:
     """One SHA-256 compression with the 64 rounds as ``lax.fori_loop``s.
 
-    Same contract as :func:`compress`, different compilation shape: the
+    Same contract as :func:`compress` (including the ``stop_round=`` /
+    ``group_state=`` factored entry points — ISSUE 14), different
+    compilation shape: the
     unrolled straight-line DAG (~2.5k ops) sends XLA:CPU's LLVM backend into
     minutes-long compiles, so the XLA-tier sweep kernel uses this rolled
     form — a ~20-op loop body that compiles in seconds everywhere.  The cost
     is materialising the 16-word schedule buffer at the broadcast lane shape
     (the loop carry must be fixed-shape), so callers bound lanes-per-chunk
-    accordingly (ops/sweep.py caps the xla tier's ``max_k``).  Pallas keeps
+    accordingly (ops/sweep.py caps the xla tier's ``max_k``).  Factoring
+    shrinks exactly that cost on the rolled tier: the per-group round
+    prefix produced by ``stop_round=p`` runs (and carries) at the
+    group-scalar ``(B, 1)`` column shape, and only the resumed rounds
+    broadcast to the full inner-lane shape.  Pallas keeps
     the unrolled form: Mosaic compiles per-tile straight-line code fast and
     the rounds stay in vector registers.
     """
     from jax import lax
 
-    shape = jnp.broadcast_shapes(
-        *(jnp.shape(x) for x in w), *(jnp.shape(s) for s in state)
-    )
     # A pallas kernel body may not close over array constants; such callers
     # pass their own k_table built from inline scalars (pallas_sha256.py).
     k_arr = jnp.asarray(K) if k_table is None else k_table
-    wbuf = jnp.stack(
-        [jnp.broadcast_to(jnp.asarray(x, jnp.uint32), shape) for x in w]
-    )
-    st0 = tuple(
-        jnp.broadcast_to(jnp.asarray(s, jnp.uint32), shape) for s in state
-    )
+
+    def _bcast(xs, shp):
+        return tuple(
+            jnp.broadcast_to(jnp.asarray(x, jnp.uint32), shp) for x in xs
+        )
 
     def _round(t, st, wt):
         a, b, c, d, e, f, g, h = st
@@ -208,7 +254,38 @@ def compress_rolled(
         buf = lax.dynamic_update_index_in_dim(buf, wt, t % 16, 0)
         return _round(t, st, wt), buf
 
-    st, wbuf = lax.fori_loop(0, 16, lambda t, c: phase1(t, c), (st0, wbuf))
+    start = 0 if group_state is None else group_state[0]
+    init = state if group_state is None else group_state[1]
+    if stop_round is not None:
+        if not start <= stop_round <= 16:
+            raise ValueError(
+                f"stop_round must be in [{start}, 16], got {stop_round}"
+            )
+        # Prefix producer: only w[0:stop_round] is consumed, so the
+        # broadcast shape — and the fori_loop carry — stays at the
+        # group-scalar shape the caller passed (no inner-lane broadcast).
+        words = list(w)[:stop_round]
+        pshape = jnp.broadcast_shapes(
+            *(jnp.shape(x) for x in words), *(jnp.shape(s) for s in init)
+        )
+        st = _bcast(init, pshape)
+        if stop_round == start:
+            return (stop_round, st)
+        pbuf = jnp.stack(_bcast(words, pshape))
+        st, _ = lax.fori_loop(
+            start, stop_round, lambda t, c: phase1(t, c), (st, pbuf)
+        )
+        return (stop_round, st)
+
+    shape = jnp.broadcast_shapes(
+        *(jnp.shape(x) for x in w),
+        *(jnp.shape(s) for s in state),
+        *(jnp.shape(s) for s in init),
+    )
+    wbuf = jnp.stack(_bcast(w, shape))
+    st0 = _bcast(state, shape)
+    st = _bcast(init, shape) if group_state is not None else st0
+    st, wbuf = lax.fori_loop(start, 16, lambda t, c: phase1(t, c), (st, wbuf))
     st, _ = lax.fori_loop(16, 64, lambda t, c: phase2(t, c), (st, wbuf))
     if final_only:  # same contract as compress: (a, b), or (a,) for "h0"
         if final_only == "h0":
@@ -291,6 +368,82 @@ class MsgLayout:
     def static_key(self) -> Tuple:
         """Hashable key of everything that shapes the compiled kernel."""
         return (self.n_tail_blocks, self.digit_pos)
+
+    def factor(self, k: int, k_in: int) -> "FactorSplit":
+        """Outer/inner split of this layout's ``k`` in-kernel digits
+        (ISSUE 14) — see :func:`factor_low_pos`."""
+        if k > self.digit_count:
+            raise ValueError(f"k ({k}) exceeds digit_count ({self.digit_count})")
+        return factor_low_pos(self.digit_pos[self.digit_count - k :], k_in)
+
+
+@dataclass(frozen=True)
+class FactorSplit:
+    """Outer/inner factoring of the ``k`` in-kernel digits (ISSUE 14).
+
+    A 10^k-aligned chunk's lane axis ``10^k`` factors as **outer × inner**
+    groups ``10^k_out × 10^k_in``: the kernel's lane iota covers only the
+    low ``k_in`` digits (``inner_pos``), while the high ``k_out`` varying
+    digits (``outer_pos``) become a per-group loop — the sequential pallas
+    grid dimension / an outer ``fori_loop`` on the xla tier — whose ASCII
+    bytes are patched into the word template as per-group SCALARS
+    (:func:`outer_patch_table`).  Every SHA-256 round at or before
+    ``first_inner_word`` then consumes only group-constant words, so its
+    state is computed once per group on the scalar unit (``compress``'s
+    ``stop_round=`` / ``group_state=`` entry points) and only the rounds
+    from the first inner-digit word on run at the vector lane shape.
+    """
+
+    k_out: int
+    k_in: int
+    outer_pos: Tuple[DigitPos, ...]  # high k_out of the k low digits
+    inner_pos: Tuple[DigitPos, ...]  # low k_in digits (the lane iota's)
+    first_inner_word: int  # flat tail-word index where vectorness starts
+
+
+def factor_low_pos(low_pos: Tuple[DigitPos, ...], k_in: int) -> FactorSplit:
+    """Split the ``k`` low digit positions into the outer/inner groups of
+    a factored kernel.  ``1 <= k_in < k`` (a factoring with no outer digit
+    is just the baseline kernel; callers gate on ``k >= 2``)."""
+    k = len(low_pos)
+    if not 1 <= k_in < k:
+        raise ValueError(f"k_in must be in [1, {k - 1}], got {k_in}")
+    outer_pos = tuple(low_pos[: k - k_in])
+    inner_pos = tuple(low_pos[k - k_in :])
+    return FactorSplit(
+        k_out=k - k_in,
+        k_in=k_in,
+        outer_pos=outer_pos,
+        inner_pos=inner_pos,
+        first_inner_word=min(dp.word for dp in inner_pos),
+    )
+
+
+@lru_cache(maxsize=64)
+def outer_patch_table(
+    outer_pos: Tuple[DigitPos, ...],
+) -> Tuple[Tuple[int, ...], np.ndarray]:
+    """Per-group template patching for a factored kernel (ISSUE 14).
+
+    Returns ``(words, table)``: the distinct tail-word indices the outer
+    digits touch (ascending) and a ``(10^k_out, len(words))`` uint32 table
+    whose row ``g`` holds the OR-masks that patch outer-group ``g``'s
+    ASCII digits into those words.  Rides the kernel as a (tiny) SMEM
+    operand on pallas / a trace constant on xla, so per-group patching is
+    pure scalar ORs — no in-kernel div/mod (Mosaic lowers integer
+    division poorly, ops/pallas_sha256.py module docstring).
+    """
+    k_out = len(outer_pos)
+    words = tuple(sorted({dp.word for dp in outer_pos}))
+    widx = {w: m for m, w in enumerate(words)}
+    g = np.arange(10**k_out, dtype=np.int64)
+    table = np.zeros((10**k_out, len(words)), dtype=np.uint32)
+    for j, dp in enumerate(outer_pos):
+        p = 10 ** (k_out - 1 - j)
+        dig = ((g // p) % 10 + 48).astype(np.uint32) << np.uint32(dp.shift)
+        table[:, widx[dp.word]] |= dig
+    table.setflags(write=False)
+    return words, table
 
 
 def build_layout(data: bytes, digit_count: int, sep: bytes = b" ") -> MsgLayout:

@@ -37,7 +37,15 @@ import numpy as np
 from ..utils import trace as _trace
 from ..utils.metrics import METRICS
 from ..utils.platform import is_tpu
-from .sha256 import DigitPos, MsgLayout, build_layout, compress, compress_rolled
+from .sha256 import (
+    DigitPos,
+    MsgLayout,
+    build_layout,
+    compress,
+    compress_rolled,
+    factor_low_pos,
+    outer_patch_table,
+)
 
 U32_MAX = 0xFFFFFFFF
 I32_MAX = 0x7FFFFFFF
@@ -103,6 +111,17 @@ def decompose_range(lower: int, upper: int, max_k: int = 6) -> Iterator[ChunkGro
 # --------------------------------------------------------------------------
 
 
+def default_factor_k_in(k: int) -> int:
+    """The factored kernel's inner digit count for a ``k``-digit lane axis
+    (ISSUE 14): keep the outer group count ``10^(k - k_in)`` at <= 1000
+    (the sequential per-group loop / grid axis) while leaving the inner
+    lane tile as wide as that allows.  k=6 → 3 (1000 groups × 1000
+    lanes, the flagship pallas shape); k=5 → 3; k=2 → 1.  Shared by the
+    kernel builders and tools/roofline.py so the op audit models exactly
+    the split that runs."""
+    return min(3, max(1, k - 2))
+
+
 def make_kernel_body(
     n_tail_blocks: int,
     low_pos: Tuple[DigitPos, ...],
@@ -110,6 +129,7 @@ def make_kernel_body(
     batch: int,
     rolled: Optional[bool] = None,
     sieve: bool = False,
+    factored: int = 0,
 ):
     """Build the pure (un-jitted) min-hash kernel body for one
     (layout, k, batch) shape class.
@@ -130,9 +150,23 @@ def make_kernel_body(
     ``any(h0 <= thresh)`` survivor bit (ties conservatively survive);
     the full ``(h0, h1)`` fold + argmin runs under ``lax.cond`` only
     when a survivor exists, else ``(U32_MAX, U32_MAX, I32_MAX)`` comes
-    back and the host keeps its best.  This tier has no sequential grid,
-    so the threshold tightens only between dispatches (host-side);
-    the pallas tier also tightens it across the grid in SMEM scratch.
+    back and the host keeps its best.  Unfactored, this tier has no
+    sequential dimension, so the threshold tightens only between
+    dispatches (host-side); the pallas tier also tightens it across the
+    grid in SMEM scratch.
+
+    ``factored=k_in`` (ISSUE 14) factors the lane axis into ``10^(k -
+    k_in)`` outer × ``10^k_in`` inner digit groups: the lane iota covers
+    only the low ``k_in`` digits, the outer digits become an outer
+    ``fori_loop`` whose ASCII bytes patch the template as per-group
+    ``(B, 1)`` scalars, and every round before the first inner-digit
+    word is computed once per group at the scalar column shape
+    (``compress``'s ``stop_round=`` / ``group_state=`` entry points) —
+    the per-group scalar round prefix is shared by the sieve's pass 1
+    AND pass 2.  Composing with ``sieve=True``, the group loop IS a
+    sequential dimension, so the threshold now also tightens across
+    groups within one dispatch (``min(thresh, carried best h0)``) —
+    the xla tier's analogue of the pallas SMEM tightening.
     """
     n_lanes = 10**k
     if rolled is None:
@@ -170,11 +204,13 @@ def make_kernel_body(
             state = comp(state, w, final_only=(final_form if last else False))
         return state
 
-    def _fold(i, state, bounds):
+    def _fold(i, state, bounds, lanes=n_lanes):
         """The full lexicographic min + argmin reduction (both tiers'
-        pass 2; the whole baseline kernel)."""
-        h0 = jnp.broadcast_to(state[0], (batch, n_lanes))
-        h1 = jnp.broadcast_to(state[1], (batch, n_lanes))
+        pass 2; the whole baseline kernel).  ``lanes`` is the fold's lane
+        width — ``n_lanes`` for the baseline grid, ``10^k_in`` for one
+        outer group of the factored kernel."""
+        h0 = jnp.broadcast_to(state[0], (batch, lanes))
+        h1 = jnp.broadcast_to(state[1], (batch, lanes))
 
         valid = (i[None, :] >= bounds[:, :1]) & (i[None, :] < bounds[:, 1:2])
         h0 = jnp.where(valid, h0, jnp.uint32(U32_MAX))
@@ -183,7 +219,7 @@ def make_kernel_body(
         h0f = h0.reshape(-1)
         h1f = h1.reshape(-1)
         validf = valid.reshape(-1)
-        flat = jnp.arange(batch * n_lanes, dtype=jnp.int32)
+        flat = jnp.arange(batch * lanes, dtype=jnp.int32)
 
         min_h0 = jnp.min(h0f)
         e0 = h0f == min_h0
@@ -192,6 +228,145 @@ def make_kernel_body(
         e1 = e0 & (h1f == min_h1) & validf
         flat_idx = jnp.min(jnp.where(e1, flat, jnp.int32(I32_MAX)))
         return min_h0, min_h1, flat_idx
+
+    if factored:
+        from jax import lax
+
+        split = factor_low_pos(low_pos, factored)
+        s_in = 10**split.k_in
+        g_count = 10**split.k_out
+        owords, otab_np = outer_patch_table(split.outer_pos)
+        owidx = {wd: m for m, wd in enumerate(owords)}
+        fib, prefix_rounds = divmod(split.first_inner_word, 16)
+
+        def _assemble_group(midstate, tail_const, og):
+            """Per-outer-group w assembly: inner-digit contributions over
+            the 10^k_in lane iota (vector), outer group ``og``'s digits
+            OR-patched into the template as ``(B, 1)`` scalar columns."""
+            i = jnp.arange(s_in, dtype=jnp.int32)
+            contrib = {}
+            for j, dp in enumerate(split.inner_pos):
+                p = 10 ** (split.k_in - 1 - j)
+                dig = ((i // p) % 10 + 48).astype(jnp.uint32) << jnp.uint32(dp.shift)
+                contrib[dp.word] = (
+                    contrib[dp.word] | dig if dp.word in contrib else dig
+                )
+            orow = lax.dynamic_index_in_dim(
+                jnp.asarray(otab_np), og, 0, keepdims=False
+            )
+            state = tuple(midstate[s] for s in range(8))
+            blocks = []
+            for b in range(n_tail_blocks):
+                wl = []
+                for widx in range(b * 16, (b + 1) * 16):
+                    col = tail_const[:, widx][:, None]  # (B, 1)
+                    if widx in owidx:
+                        col = col | orow[owidx[widx]]  # per-group scalar OR
+                    if widx in contrib:
+                        wl.append(col | contrib[widx][None, :])  # (B, s_in)
+                    else:
+                        wl.append(col)
+                blocks.append(wl)
+            return i, state, blocks
+
+        def _group_prefix(state, blocks):
+            """The per-group scalar round prefix: every block before the
+            first inner-digit word, plus that block's leading rounds, all
+            at the ``(B, 1)`` group-scalar shape — computed ONCE per
+            group and shared by the sieve's pass 1 and pass 2.  Returns
+            ``(state entering block fib, carried group_state)``."""
+            for b in range(fib):
+                state = comp(state, blocks[b])
+            return state, comp(state, blocks[fib], stop_round=prefix_rounds)
+
+        def _hash_resumed(state_fib, gs, blocks, final_form):
+            """The vector rounds: resume block ``fib`` from the carried
+            group state, then run any remaining blocks normally."""
+            st = state_fib
+            for b in range(fib, n_tail_blocks):
+                fo = final_form if b == n_tail_blocks - 1 else False
+                if b == fib:
+                    st = comp(st, blocks[b], final_only=fo, group_state=gs)
+                else:
+                    st = comp(st, blocks[b], final_only=fo)
+            return st
+
+        def _combine(carry, h0, h1, fi, og):
+            """Fold one group's result into the carried best.  Full
+            lexicographic compare INCLUDING the remapped global flat
+            index: ties across groups are NOT first-wins (a later
+            group's row-0 lane is a lower flat index — and nonce — than
+            an earlier group's row-3 lane)."""
+            bh0, bh1, bidx = carry
+            gidx = jnp.where(
+                fi == jnp.int32(I32_MAX),
+                jnp.int32(I32_MAX),
+                (fi // s_in) * n_lanes + og * s_in + fi % s_in,
+            )
+            better = (h0 < bh0) | (
+                (h0 == bh0) & ((h1 < bh1) | ((h1 == bh1) & (gidx < bidx)))
+            )
+            return (
+                jnp.where(better, h0, bh0),
+                jnp.where(better, h1, bh1),
+                jnp.where(better, gidx, bidx),
+            )
+
+        _start = (
+            jnp.uint32(U32_MAX), jnp.uint32(U32_MAX), jnp.int32(I32_MAX),
+        )
+
+        if not sieve:
+
+            def kernel(midstate, tail_const, bounds):
+                def body(og, carry):
+                    i, state, blocks = _assemble_group(midstate, tail_const, og)
+                    state_fib, gs = _group_prefix(state, blocks)
+                    # Per-group lane bounds: clipping host bounds into
+                    # [0, s_in) also masks every lane of a group the
+                    # chunk's [lo, hi) doesn't reach.
+                    gb = jnp.clip(bounds - og * s_in, 0, s_in)
+                    st = _hash_resumed(state_fib, gs, blocks, True)
+                    return _combine(
+                        carry, *_fold(i, st, gb, lanes=s_in), og
+                    )
+
+                return lax.fori_loop(0, g_count, body, _start)
+
+            return kernel
+
+        def kernel(midstate, tail_const, bounds, thresh):
+            def body(og, carry):
+                i, state, blocks = _assemble_group(midstate, tail_const, og)
+                state_fib, gs = _group_prefix(state, blocks)
+                gb = jnp.clip(bounds - og * s_in, 0, s_in)
+                # The group loop is a sequential dimension: tighten the
+                # dispatch threshold with the best h0 carried so far, so
+                # later groups sieve against the freshest bound (the xla
+                # analogue of the pallas SMEM-scratch tightening).
+                th = jnp.minimum(thresh, carry[0])
+                # Pass 1: h0-only from the shared group prefix.
+                (p1_h0,) = _hash_resumed(state_fib, gs, blocks, "h0")
+                h0v = jnp.broadcast_to(p1_h0, (batch, s_in))
+                valid = (i[None, :] >= gb[:, :1]) & (i[None, :] < gb[:, 1:2])
+                h0v = jnp.where(valid, h0v, jnp.uint32(U32_MAX))
+                # <= not <: ties conservatively survive (see above).
+                surv = jnp.any(h0v <= th)
+
+                def _pass2(_):
+                    return _fold(
+                        i, _hash_resumed(state_fib, gs, blocks, True), gb,
+                        lanes=s_in,
+                    )
+
+                def _none(_):
+                    return _start
+
+                return _combine(carry, *lax.cond(surv, _pass2, _none, 0), og)
+
+            return lax.fori_loop(0, g_count, body, _start)
+
+        return kernel
 
     if not sieve:
 
@@ -237,10 +412,14 @@ def _make_kernel(
     batch: int,
     rolled: bool,
     sieve: bool = False,
+    factored: int = 0,
 ):
     """Jitted single-device wrapper over :func:`make_kernel_body`."""
     return jax.jit(
-        make_kernel_body(n_tail_blocks, low_pos, k, batch, rolled, sieve=sieve)
+        make_kernel_body(
+            n_tail_blocks, low_pos, k, batch, rolled, sieve=sieve,
+            factored=factored,
+        )
     )
 
 
@@ -314,11 +493,12 @@ def auto_tune(
     batch: Optional[int],
     max_k: Optional[int],
     sieve: Optional[bool] = None,
-) -> Tuple[str, int, int, bool]:
-    """Resolve the (backend, rows-per-dispatch, max_k, sieve) defaults
-    shared by the single-device and sharded sweep drivers.  max_k=5 bounds
-    the xla tier's compress_rolled schedule buffer ((16, B, 10^k) u32) to
-    ~50 MB at B=8.
+    factored: Optional[bool] = None,
+) -> Tuple[str, int, int, bool, bool]:
+    """Resolve the (backend, rows-per-dispatch, max_k, sieve, factored)
+    defaults shared by the single-device and sharded sweep drivers.
+    max_k=5 bounds the xla tier's compress_rolled schedule buffer
+    ((16, B, 10^k) u32) to ~50 MB at B=8.
 
     The **sieve rung** (ISSUE 13, ``sieve=None`` = auto): the two-stage
     sieve kernel is ON for the pallas tier — pass 1's predicate epilogue
@@ -326,12 +506,34 @@ def auto_tune(
     bookkeeping it replaces (tools/roofline.py prints both), and
     survivor groups vanish as the running min falls like
     ``U32_MAX / nonces_swept`` — and OFF for the xla tier, where the
-    sieve measurably LOSES: compress_rolled re-materialises the full
-    (16, B, 10^k) schedule buffer per pass and ``lax.cond`` re-runs the
-    whole compression on survivor dispatches, so the baseline kernel
-    stays (measured on this host, both legs in BENCH_pr13.json;
-    ``bench.py --sieve-compare`` re-measures any shape).  A shape where
-    the sieve loses therefore keeps the current kernel by default."""
+    sieve measurably LOSES — originally 2x with the baseline kernel
+    (BENCH_pr13.json: the full (16, B, 10^k) schedule buffer
+    re-materialised per pass, no sequential dimension), and re-measured
+    under the r14 FACTORED xla default, where both of those reasons are
+    gone (per-group buffers, the group loop tightens the threshold), it
+    still loses ~5% (factored 2.45M vs factored+sieve 2.33M n/s on this
+    host: ``lax.cond`` still re-runs the inner rounds on survivor
+    dispatches), so the rung stays OFF (``bench.py --sieve-compare``
+    re-measures any shape).  A shape where
+    the sieve loses therefore keeps the current kernel by default.
+
+    The **factored rung** (ISSUE 14, ``factored=None`` = auto): the
+    outer/inner digit factoring is ON for the xla tier, where the
+    same-seed pair measured it winning **2.76×** (BENCH_pr14.json:
+    baseline 905k vs factored 2.50M n/s on this CPU host — the rolled
+    form's 16-word schedule buffer shrinks from the full
+    ``(16, B, 10^k)`` tens-of-MB shape to a per-group ``(16, B,
+    10^k_in)`` that stays cache-resident, on top of the per-group scalar
+    round prefix), and OFF for the pallas tier BY DEFAULT despite the op
+    model's win (flagship 1-block compression 3002 → 2910 folded vector
+    ops/lane, h0-only pass 1 3001 → 2909; ``tools/roofline.py
+    --ops-only`` audits any shape): the factored pallas kernel is
+    per-class STATIC — giving back the dyn kernel's digit-boundary
+    compile amortization — and its outer grid axis multiplies grid
+    programs ~4× (1024-lane inner tiles vs 4096), neither of which this
+    host can price; ``bench.py --factor-compare`` on real TPU is the
+    arbiter (ROADMAP follow-on), and a shape where factoring loses keeps
+    the current kernel by default."""
     if backend is None:
         backend = _default_backend()
     if batch is None:
@@ -347,7 +549,9 @@ def auto_tune(
         max_k = 6 if backend == "pallas" else 5
     if sieve is None:
         sieve = backend == "pallas"
-    return backend, batch, max_k, sieve
+    if factored is None:
+        factored = backend == "xla"
+    return backend, batch, max_k, sieve, factored
 
 
 @dataclass(frozen=True)
@@ -483,12 +687,15 @@ def _window_contribs_dev(k, low_pos, w_lo, w_hi, n_pad):
 
 
 def _build_kernel(
-    backend, batch, tile, cpb, interpret, rolled, layout, group, sieve=False
+    backend, batch, tile, cpb, interpret, rolled, layout, group, sieve=False,
+    factored=False,
 ):
     """One place for the backend-specific kernel construction (shared by
     the synchronous driver and SweepPipeline; the underlying factories are
     lru_cached).  ``sieve`` picks the two-stage variant of whichever
-    backend kernel applies (ISSUE 13).
+    backend kernel applies (ISSUE 13); ``factored`` the outer/inner
+    digit-factored variant (ISSUE 14, classes with ``k >= 2`` — a 1-digit
+    lane axis has nothing to factor), composable with ``sieve``.
 
     The pallas tier uses the digit-position-DYNAMIC kernel: one compiled
     executable serves every digit class d in [k+1, 20] of this data length
@@ -497,9 +704,32 @@ def _build_kernel(
     (BASELINE.md fleet section).  The returned closure carries a stable
     ``class_key`` (the shared jit fn) so SweepPipeline's single-flight
     build locks key on the executable, not the per-class wrapper.
+
+    The FACTORED pallas kernel is per-class STATIC, not dynamic — and
+    must be: the dyn kernel's word window spans every digit class's
+    possible digit bytes, and over d in [k+1, 20] the outer and inner
+    byte ranges cover the SAME window words, so a dyn-factored kernel
+    would have nothing left to demote to scalars (the whole point of the
+    split).  The cost is per-class compiles again; SweepPipeline's
+    prewarm machinery (digit-boundary speculation + single-flight build
+    locks) already exists to hide exactly that.
     """
     low_pos = layout.digit_pos[layout.digit_count - group.k :]
     if backend == "pallas":
+        if factored and group.k >= 2:
+            from .pallas_sha256 import DEFAULT_TILE, make_pallas_minhash_factored
+
+            return make_pallas_minhash_factored(
+                layout.n_tail_blocks,
+                low_pos,
+                group.k,
+                default_factor_k_in(group.k),
+                batch,
+                tile=tile if tile is not None else DEFAULT_TILE,
+                interpret=interpret,
+                cpb=cpb,
+                sieve=sieve,
+            )
         from .pallas_sha256 import (
             DEFAULT_TILE,
             dyn_params,
@@ -543,7 +773,8 @@ def _build_kernel(
         kern.class_key = fn
         return kern
     return _make_kernel(
-        layout.n_tail_blocks, low_pos, group.k, batch, rolled, sieve
+        layout.n_tail_blocks, low_pos, group.k, batch, rolled, sieve,
+        default_factor_k_in(group.k) if factored and group.k >= 2 else 0,
     )
 
 
@@ -624,6 +855,7 @@ class SweepPipeline:
         axis_name: str = "miners",
         workload=None,
         sieve: Optional[bool] = None,
+        factored: Optional[bool] = None,
     ) -> None:
         import queue as _queue
         import threading
@@ -642,15 +874,21 @@ class SweepPipeline:
 
             if not is_tpu_device(mesh.devices.flat[0]):
                 backend = "xla"
-        self._backend, self._batch, self._max_k, self._sieve = auto_tune(
-            backend, batch, max_k, sieve
-        )
+        (
+            self._backend, self._batch, self._max_k, self._sieve,
+            self._factored,
+        ) = auto_tune(backend, batch, max_k, sieve, factored)
         if mesh is not None:
-            # The sharded tier keeps the baseline kernel: its collective
-            # argmin cascade needs every device's minimum each dispatch,
-            # so a per-shard survivor predicate saves nothing yet (the
-            # per-shard sieve is a named ROADMAP follow-on).
-            self._sieve = False
+            # The sharded tier runs the PER-SHARD sieve (ISSUE 14
+            # satellite): each shard seeds pass 1 from the dispatch
+            # threshold and tightens its own local running-min in SMEM
+            # scratch ahead of the collective argmin cascade — a shard
+            # with no survivor contributes the sentinel, which the pmin
+            # cascade orders after any real survivor.  Factoring stays
+            # off in mesh mode for now (the sharded kernels keep the
+            # baseline/dyn forms; a factored sharded tier is a ROADMAP
+            # follow-on).
+            self._factored = False
         self._tile = tile
         self._cpb = cpb
         self._interpret = interpret
@@ -815,6 +1053,7 @@ class SweepPipeline:
                 self._backend,
                 self._interpret,
                 self._rolled,
+                sieve=self._sieve,
             )
         return _build_kernel(
             self._backend,
@@ -826,6 +1065,7 @@ class SweepPipeline:
             layout,
             group,
             sieve=self._sieve,
+            factored=self._factored,
         )
 
     def _invoke(self, kern, midstate, tail_const, bounds, thresh=None):
@@ -834,7 +1074,7 @@ class SweepPipeline:
 
             return sharded_invoke(
                 kern, midstate, tail_const, bounds,
-                self._mesh, self._axis_name,
+                self._mesh, self._axis_name, thresh=thresh,
             )
         return _invoke_kernel(
             self._backend, kern, midstate, tail_const, bounds, thresh=thresh
@@ -994,6 +1234,7 @@ def sweep_min_hash(
     host_lane_budget: int = 0,
     workload=None,
     sieve: Optional[bool] = None,
+    factored: Optional[bool] = None,
 ) -> SweepResult:
     """Find ``(min Hash(data, n), argmin n)`` over inclusive ``[lower,
     upper]`` on the default JAX device.  Bit-exact vs the hashlib oracle
@@ -1016,8 +1257,14 @@ def sweep_min_hash(
     :func:`auto_tune` rung for this backend): dispatches carry the
     running-min h0 as a threshold operand and the full fold runs only on
     survivors — bit-exact either way (ties conservatively survive).
+    ``factored`` = the outer/inner digit-factored kernel (ISSUE 14; None
+    = the :func:`auto_tune` rung): the lane axis splits into outer digit
+    groups whose invariant round prefix is computed once per group on
+    the scalar unit — composable with ``sieve``, bit-exact either way.
     """
-    backend, batch, max_k, sieve = auto_tune(backend, batch, max_k, sieve)
+    backend, batch, max_k, sieve, factored = auto_tune(
+        backend, batch, max_k, sieve, factored
+    )
     rolled = not is_tpu()
     sep, host_min, _native_ok = _workload_knobs(workload)
 
@@ -1026,7 +1273,7 @@ def sweep_min_hash(
     def get_kernel(layout, group):
         return _build_kernel(
             backend, batch, tile, cpb, interpret, rolled, layout, group,
-            sieve=sieve,
+            sieve=sieve, factored=factored,
         )
 
     def run_kernel(kern, midstate, tail_const, bounds):

@@ -77,6 +77,16 @@ def _collective_min(h0, h1, flat, axis: str):
     return g_h0, g_h1, g_dev, g_flat
 
 
+def _flip_thresh(thresh):
+    """uint32 scalar threshold → the (1,) sign-flipped int32 operand the
+    pallas sieve kernels compare in (same domain as _invoke_kernel's
+    host-side conversion, but traced — the sharded thresh operand rides
+    the dispatch replicated as plain uint32)."""
+    return lax.bitcast_convert_type(
+        thresh ^ jnp.uint32(0x80000000), jnp.int32
+    ).reshape(1)
+
+
 @lru_cache(maxsize=256)
 def _make_sharded_kernel(
     n_tail_blocks: int,
@@ -88,6 +98,7 @@ def _make_sharded_kernel(
     backend: str,
     interpret: bool,
     rolled: bool,
+    sieve: bool = False,
 ):
     """Compile the sharded kernel for one (layout, k, batch) shape class
     (the xla tier, and the pallas static fallback for the d == k class).
@@ -96,31 +107,48 @@ def _make_sharded_kernel(
     -> (g_h0, g_h1, g_dev, g_flat)`` replicated scalars, where
     ``B = n_devices * per_dev_batch`` and rows are sharded contiguously
     along ``axis_name``.
+
+    ``sieve=True`` is the PER-SHARD sieve (ISSUE 14 satellite): the fn
+    takes an extra replicated uint32 ``thresh`` scalar; each shard runs
+    the two-stage kernel locally — seeding pass 1 from the dispatch
+    threshold and (pallas) tightening its own running min in SMEM
+    scratch — AHEAD of the collective argmin cascade.  A shard with no
+    survivor contributes the ``(U32_MAX, U32_MAX, I32_MAX)`` sentinel,
+    which is correct under the cascade: no survivor means every lane on
+    that shard exceeds the threshold, and any OTHER shard's survivor is
+    <= the threshold, so the sentinel never outranks a real minimum
+    (ties conservatively survive shard-locally, same as single-device).
     """
     if backend == "pallas":
         from ..ops.pallas_sha256 import make_pallas_minhash
 
         pallas_fn = make_pallas_minhash(
-            n_tail_blocks, low_pos, k, per_dev_batch, interpret=interpret
+            n_tail_blocks, low_pos, k, per_dev_batch, interpret=interpret,
+            sieve=sieve,
         )
 
-        def local(midstate, tail_const, bounds):
+        def local(midstate, tail_const, bounds, *th):
             tailcb = jnp.concatenate(
                 [tail_const, bounds.astype(jnp.uint32)], axis=1
             )
+            if sieve:
+                return pallas_fn(midstate, tailcb, _flip_thresh(th[0]))
             return pallas_fn(midstate, tailcb)
 
     else:
-        local = make_kernel_body(n_tail_blocks, low_pos, k, per_dev_batch, rolled)
+        local = make_kernel_body(
+            n_tail_blocks, low_pos, k, per_dev_batch, rolled, sieve=sieve
+        )
 
-    def shard_fn(midstate, tail_const, bounds):
-        h0, h1, flat = local(midstate, tail_const, bounds)
+    def shard_fn(midstate, tail_const, bounds, *th):
+        h0, h1, flat = local(midstate, tail_const, bounds, *th)
         return _collective_min(h0, h1, flat, axis_name)
 
     mapped = _shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(), P(axis_name, None), P(axis_name, None)),
+        in_specs=(P(), P(axis_name, None), P(axis_name, None))
+        + ((P(),) if sieve else ()),
         out_specs=(P(), P(), P(), P()),
         # pallas_call's out_shape carries no varying-mesh-axes annotation, so
         # the vma checker can't see through it; the collective cascade above
@@ -164,6 +192,7 @@ def _make_sharded_kernel_dyn(
     mesh: Mesh,
     axis_name: str,
     interpret: bool,
+    sieve: bool = False,
 ):
     """Sharded form of the digit-position-DYNAMIC pallas kernel: ONE
     compiled SPMD executable serves every digit class d in [k+1, 20] of a
@@ -171,27 +200,36 @@ def _make_sharded_kernel_dyn(
     `_build_kernel`) — a multi-chip sweep crossing a decimal digit
     boundary never re-traces or re-loads.
 
-    Returned jitted fn: ``(midstate, tail_const, bounds, *contribs)`` with
-    contribs replicated (one (n_pad/128, 128) u32 tile per window word).
+    Returned jitted fn: ``(midstate, tail_const, bounds, [thresh,]
+    *contribs)`` with contribs replicated (one (n_pad/128, 128) u32 tile
+    per window word); ``sieve=True`` adds the replicated uint32 thresh
+    scalar of the per-shard sieve (see :func:`_make_sharded_kernel`).
     """
     from ..ops.pallas_sha256 import make_pallas_minhash_dyn
 
     pallas_fn, n_pad = make_pallas_minhash_dyn(
-        n_tail_blocks, w_lo, w_hi, k, per_dev_batch, interpret=interpret
+        n_tail_blocks, w_lo, w_hi, k, per_dev_batch, interpret=interpret,
+        sieve=sieve,
     )
     n_window = w_hi - w_lo + 1
 
-    def shard_fn(midstate, tail_const, bounds, *contribs):
+    def shard_fn(midstate, tail_const, bounds, *rest):
         tailcb = jnp.concatenate(
             [tail_const, bounds.astype(jnp.uint32)], axis=1
         )
-        h0, h1, flat = pallas_fn(midstate, tailcb, *contribs)
+        if sieve:
+            h0, h1, flat = pallas_fn(
+                midstate, tailcb, _flip_thresh(rest[0]), *rest[1:]
+            )
+        else:
+            h0, h1, flat = pallas_fn(midstate, tailcb, *rest)
         return _collective_min(h0, h1, flat, axis_name)
 
     mapped = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(), P(axis_name, None), P(axis_name, None))
+        + ((P(),) if sieve else ())
         + (P(None, None),) * n_window,
         out_specs=(P(), P(), P(), P()),
         check_vma=False,  # same rationale as the static form above
@@ -208,12 +246,15 @@ def sharded_kernel_for(
     backend: str,
     interpret: bool,
     rolled: bool,
+    sieve: bool = False,
 ):
     """Build (or fetch cached) the sharded kernel closure for one digit
-    class: ``kern(midstate, tail_const, bounds) -> (g_h0, g_h1, g_dev,
-    g_flat)``.  Shared by the synchronous sharded driver below and the
-    mesh mode of ``ops.sweep.SweepPipeline``; dyn-kernel closures carry
-    ``class_key`` for the pipeline's single-flight build locks."""
+    class: ``kern(midstate, tail_const, bounds, *th) -> (g_h0, g_h1,
+    g_dev, g_flat)`` (``*th`` is the one replicated uint32 threshold
+    operand when ``sieve=True``, empty otherwise).  Shared by the
+    synchronous sharded driver below and the mesh mode of
+    ``ops.sweep.SweepPipeline``; dyn-kernel closures carry ``class_key``
+    for the pipeline's single-flight build locks."""
     low_pos = layout.digit_pos[layout.digit_count - group.k :]
     if backend == "pallas":
         from ..ops.pallas_sha256 import dyn_params
@@ -230,13 +271,14 @@ def sharded_kernel_for(
                 mesh,
                 axis_name,
                 interpret,
+                sieve=sieve,
             )
             contribs = _mesh_contribs(
                 group.k, low_pos, w_lo, w_hi, n_pad, mesh
             )
 
-            def kern(midstate, tail_const, bounds, _fn=fn, _c=contribs):
-                return _fn(midstate, tail_const, bounds, *_c)
+            def kern(midstate, tail_const, bounds, *th, _fn=fn, _c=contribs):
+                return _fn(midstate, tail_const, bounds, *th, *_c)
 
             kern.class_key = fn
             return kern
@@ -252,18 +294,30 @@ def sharded_kernel_for(
         backend,
         interpret,
         rolled,
+        sieve=sieve,
     )
 
 
-def sharded_invoke(kern, midstate, tail_const, bounds, mesh: Mesh, axis_name: str):
+def sharded_invoke(
+    kern, midstate, tail_const, bounds, mesh: Mesh, axis_name: str,
+    thresh=None,
+):
     """Queue one sharded dispatch: rows sharded contiguously along
-    ``axis_name``, midstate replicated."""
+    ``axis_name``, midstate replicated.  ``thresh`` (per-shard sieve
+    kernels only): the host's running-min h0 as a plain int — replicated
+    to every shard as a uint32 scalar."""
     row = NamedSharding(mesh, P(axis_name, None))
     rep = NamedSharding(mesh, P())
+    th = ()
+    if thresh is not None:
+        import numpy as _np
+
+        th = (jax.device_put(_np.uint32(thresh), rep),)
     return kern(
         jax.device_put(midstate, rep),
         jax.device_put(tail_const, row),
         jax.device_put(bounds, row),
+        *th,
     )
 
 
@@ -280,6 +334,7 @@ def sweep_min_hash_sharded(
     interpret: bool = False,
     stats: Optional[dict] = None,
     workload=None,
+    sieve: Optional[bool] = None,
 ) -> SweepResult:
     """Multi-chip ``(min Hash(data, n), argmin n)`` over inclusive
     ``[lower, upper]``; bit-exact vs the hashlib oracle, lowest-nonce ties.
@@ -288,6 +343,15 @@ def sweep_min_hash_sharded(
     (padded rows have empty lane bounds and are masked in-kernel).  Results
     are fetched lazily after all dispatches are queued so the device
     pipeline stays full.
+
+    ``sieve`` (ISSUE 14 satellite, None = the :func:`auto_tune` rung for
+    this backend): the PER-SHARD two-stage sieve — each dispatch carries
+    the host's running-min h0 replicated to every shard, each shard's
+    pass 1 seeds from it (and, on pallas, tightens its own local running
+    min in SMEM scratch) ahead of the collective argmin cascade, and a
+    survivor-less shard contributes the sentinel the cascade orders
+    last.  Bit-exact either way; the sharded tier no longer forces the
+    baseline kernel.
 
     ``stats``, if given, is filled with dispatch-overlap accounting:
     ``dispatches`` (count), ``fetch_wait_seconds`` (host time blocked on
@@ -299,23 +363,19 @@ def sweep_min_hash_sharded(
     mesh_on_tpu = is_tpu_device(mesh.devices.flat[0])
     if backend is None and not mesh_on_tpu:
         backend = "xla"
-    # The sharded tier keeps the baseline kernel (auto_tune's sieve rung
-    # is single-device only): the collective argmin cascade needs every
-    # device's minimum each dispatch — a per-shard sieve is a ROADMAP
-    # follow-on.
-    backend, batch_per_device, max_k, _sieve = auto_tune(
-        backend, batch_per_device, max_k, sieve=False
+    # Factoring stays off in the sharded tier (ops/sweep.py SweepPipeline
+    # mesh mode pins it the same way): the sharded kernels keep the
+    # baseline/dyn forms; a factored sharded tier is a ROADMAP follow-on.
+    backend, batch_per_device, max_k, sieve, _factored = auto_tune(
+        backend, batch_per_device, max_k, sieve, factored=False
     )
     rolled = not mesh_on_tpu
     batch = n_dev * batch_per_device
 
-    row_sharding = NamedSharding(mesh, P(axis_name, None))
-    rep_sharding = NamedSharding(mesh, P())
-
     def get_kernel(layout, group):
         return sharded_kernel_for(
             layout, group, batch_per_device, mesh, axis_name, backend,
-            interpret, rolled,
+            interpret, rolled, sieve=sieve,
         )
 
     if stats is not None:
@@ -324,10 +384,13 @@ def sweep_min_hash_sharded(
     def run_kernel(kern, midstate, tail_const, bounds):
         if stats is not None:
             stats["dispatches"] += 1
-        return kern(
-            jax.device_put(midstate, rep_sharding),
-            jax.device_put(tail_const, row_sharding),
-            jax.device_put(bounds, row_sharding),
+        th = None
+        if sieve:
+            # Enqueue-time running-min h0; a stale (looser) read is
+            # conservative-correct, same as the single-device driver.
+            th = (best[0][0] >> 32) if best else U32_MAX
+        return sharded_invoke(
+            kern, midstate, tail_const, bounds, mesh, axis_name, thresh=th
         )
 
     best: list = []

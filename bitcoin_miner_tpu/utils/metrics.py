@@ -313,6 +313,7 @@ def format_quantiles(h) -> str:
 #:   sched.chunk_size_adapt    miner chunk-size rung moves on the 10^k ladder
 #:   sched.steals              straggler chunk tails re-dispatched to idle miners
 #:   sched.prefill_chunks      chunks dispatched for speculative prefill jobs
+#:   sched.depth_adapt         adaptive pipeline-depth window re-sizes
 #:   gateway.requests          client Requests that reached the gateway
 #:   gateway.cache_hits        answered from the content-addressed cache
 #:   gateway.cache_evictions   cache entries dropped by the LRU bound

@@ -287,6 +287,43 @@ def test_trace_pass_collects_sieve_kernel_bodies():
     assert collected["ops/pallas_sha256.py"].count("minhash") >= 2
 
 
+def test_trace_pass_collects_factored_kernel_bodies():
+    """ISSUE 14 coverage meta-test: the trace-safety lint must SEE the
+    factored kernel paths on both backends — the outer-group assembly /
+    scalar-prefix / resumed-hash helpers of the xla tier's factored
+    branch (inside ``make_kernel_body``) and the factored pallas body
+    (inside ``_build_factored_call`` / ``make_pallas_minhash_factored``).
+    If a refactor moves them outside the factory convention, this test
+    (not silence) fails."""
+    import ast
+
+    from tools.analyze.common import file_comments
+    from tools.analyze.tracecheck import FACTORY_RE, _collect_kernel_bodies
+
+    # The factored factory naming is part of the convention now.
+    assert FACTORY_RE.search("make_factored_kernel")
+    assert FACTORY_RE.search("_build_factored_call")
+    assert FACTORY_RE.search("make_pallas_minhash_factored")
+    collected = {}
+    for mod in ("ops/sweep.py", "ops/pallas_sha256.py"):
+        src = (REPO / "bitcoin_miner_tpu" / mod).read_text()
+        tree = ast.parse(src)
+        names = [
+            fn.name
+            for fn in _collect_kernel_bodies(tree, file_comments(src))
+        ]
+        collected[mod] = names
+    # ops/sweep.py: the factored branch's kernel defs push the `kernel`
+    # count past the baseline+sieve pair, and its helpers are visible.
+    assert collected["ops/sweep.py"].count("kernel") >= 4
+    for helper in ("_assemble_group", "_group_prefix", "_hash_resumed"):
+        assert helper in collected["ops/sweep.py"]
+    # ops/pallas_sha256.py: the factored call's kernel body and the
+    # factored jit wrapper join the static + dyn ones.
+    assert collected["ops/pallas_sha256.py"].count("kernel") >= 2
+    assert collected["ops/pallas_sha256.py"].count("minhash") >= 3
+
+
 # --------------------------------------------------------------------------
 # 2b. lockcheck --fix: the mechanical lock fixer (ISSUE 12 carry-over)
 # --------------------------------------------------------------------------

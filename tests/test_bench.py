@@ -86,6 +86,34 @@ def test_sieve_compare_fast_leg():
     assert out["auto_tune_sieve"] == (out["kept_kernel"] == "sieve")
 
 
+def test_factor_compare_fast_leg():
+    """``--factor-compare --fast`` (ISSUE 14): the tier-1 correctness leg
+    of the factored-vs-baseline comparison — both kernels oracle-gated on
+    a digit-boundary range, the interpret-mode pallas factored kernel
+    (plain and sieve-composed) included, and the JSON honest about which
+    kernel auto_tune keeps (BENCH_pr14.json is the full-speed artifact:
+    the factored xla kernel wins 2.7x on this host, so auto_tune keeps
+    it there)."""
+    p = run_bench("--factor-compare", "--fast", "--cpu")
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = p.stdout.strip().splitlines()
+    assert len(lines) == 1, lines
+    out = json.loads(lines[0])
+    assert out["metric"] == "factor_compare"
+    assert out["bitexact"] is True
+    assert out["interpret_pallas_factored_bitexact"] is True
+    assert out["baseline_nps"] > 0 and out["factored_nps"] > 0
+    assert out["fast"] is True
+    # The honesty contract here is SELF-consistency: the JSON must record
+    # exactly what auto_tune picks for this backend.  (Unlike the sieve
+    # test, no ratio→kept coupling: the xla factored rung is calibrated
+    # on the FULL-SPEED same-seed pair — BENCH_pr14.json, 2.76× — and the
+    # --fast leg's tiny window under tier-1 load is a correctness gate,
+    # not a measurement; asserting on its noisy ratio would flake.)
+    assert out["auto_tune_factored"] == (out["kept_kernel"] == "factored")
+    assert out["kept_kernel"] in ("baseline", "factored")
+
+
 def test_cpu_bench_emits_one_valid_json_line():
     p = run_bench("--cpu")
     assert p.returncode == 0, p.stderr[-2000:]

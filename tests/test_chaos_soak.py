@@ -588,8 +588,14 @@ def test_fast_seeded_scenario_oracle_exact_per_workload(wname):
     all come from the registry, and the Result stays bit-exact against
     that workload's own hashlib oracle under packet loss."""
     w = workloads_mod.get(wname)
+    # max_nonce matches the unparameterized drill above (~7 chunks, not
+    # 3): on a fully warm process a 1500-nonce job could finish in so few
+    # datagrams that the seeded Gilbert–Elliott chain never entered its
+    # bad state, and the chaos.dropped assertion flaked on suite timing.
+    # Every workload's drill tier is a host sweep, so the extra nonces
+    # cost milliseconds.
     report = run_drill(
-        "burst-loss", seed=11, data=f"wlchaos-{wname}", max_nonce=1500,
+        "burst-loss", seed=11, data=f"wlchaos-{wname}", max_nonce=2500,
         n_miners=2, timeout=90.0,
         workload=None if wname == workloads_mod.DEFAULT_WORKLOAD else w,
     )

@@ -55,6 +55,91 @@ class TestCompress:
         digest = b"".join(int(x).to_bytes(4, "big") for x in out)
         assert digest == hashlib.sha256(b"abc").digest()
 
+    @pytest.mark.parametrize("p", [0, 3, 7, 16])
+    def test_group_state_split_is_bit_identical(self, p):
+        """ISSUE 14 contract: compress(stop_round=p) -> compress(
+        group_state=) composes to the whole compression bit-exactly, for
+        both round forms and CROSS-form (the factored xla tier produces
+        the prefix and resumes with the same rolled fn; the pallas
+        interpret path mixes via its comp shim)."""
+        import jax.numpy as jnp
+
+        from bitcoin_miner_tpu.ops.sha256 import H0, compress, compress_rolled
+
+        msg = bytearray(64)
+        msg[:3] = b"abc"
+        msg[3] = 0x80
+        msg[-8:] = (24).to_bytes(8, "big")
+        w = [
+            jnp.uint32(int.from_bytes(msg[i : i + 4], "big"))
+            for i in range(0, 64, 4)
+        ]
+        st = tuple(jnp.uint32(int(x)) for x in H0)
+        ref = [int(x) for x in compress(st, w)]
+        for producer in (compress, compress_rolled):
+            # Prefix consumes only w[0:p] — the factored kernels hand the
+            # producer group-scalar words; the resume gets the full 16.
+            gs = producer(st, w[:p], stop_round=p)
+            assert gs[0] == p
+            for resumer in (compress, compress_rolled):
+                out = [int(x) for x in resumer(st, w, group_state=gs)]
+                assert out == ref, (producer.__name__, resumer.__name__)
+        # final_only output masks compose with the resume too.
+        gs = compress(st, w, stop_round=p)
+        fo = compress(st, w, group_state=gs, final_only=True)
+        assert [int(fo[0]), int(fo[1])] == ref[:2]
+        (h0,) = compress(st, w, group_state=gs, final_only="h0")
+        assert int(h0) == ref[0]
+
+    def test_stop_round_past_schedule_rejected(self):
+        import jax.numpy as jnp
+
+        from bitcoin_miner_tpu.ops.sha256 import H0, compress, compress_rolled
+
+        w = [jnp.uint32(0)] * 16
+        st = tuple(jnp.uint32(int(x)) for x in H0)
+        for fn in (compress, compress_rolled):
+            with pytest.raises(ValueError):
+                fn(st, w, stop_round=17)
+
+
+class TestFactorSplit:
+    """The outer/inner digit split + per-group patch table (ISSUE 14)."""
+
+    def test_split_positions_and_first_inner_word(self):
+        layout = build_layout(b"cmu440", 10)
+        sp = layout.factor(6, 3)
+        assert (sp.k_out, sp.k_in) == (3, 3)
+        low = layout.digit_pos[4:]
+        assert sp.outer_pos == tuple(low[:3])
+        assert sp.inner_pos == tuple(low[3:])
+        assert sp.first_inner_word == min(dp.word for dp in sp.inner_pos)
+
+    def test_invalid_k_in_rejected(self):
+        from bitcoin_miner_tpu.ops.sha256 import factor_low_pos
+
+        layout = build_layout(b"cmu440", 10)
+        low = layout.digit_pos[4:]
+        for bad in (0, 6, 7):
+            with pytest.raises(ValueError):
+                factor_low_pos(low, bad)
+
+    def test_outer_patch_table_matches_ascii(self):
+        from bitcoin_miner_tpu.ops.sha256 import outer_patch_table
+
+        layout = build_layout(b"cmu440", 10)
+        sp = layout.factor(6, 3)
+        words, table = outer_patch_table(sp.outer_pos)
+        assert table.shape == (1000, len(words))
+        for g in (0, 7, 427, 999):
+            expect = {}
+            for j, dp in enumerate(sp.outer_pos):
+                digit = f"{g:03d}"[j]
+                expect[dp.word] = expect.get(dp.word, 0) | (
+                    ord(digit) << dp.shift
+                )
+            assert [int(x) for x in table[g]] == [expect[w] for w in words]
+
 
 class TestDecompose:
     def test_cover_exact_no_overlap(self):
@@ -548,6 +633,162 @@ class TestSieve:
         h0, h1, idx = fn(midstate, tailcb, thresh)
         assert (int(h0), int(h1)) == (eh0, eh1)
         assert int(idx) < 100  # row 0, not the duplicate row 1
+
+
+class TestFactored:
+    """Factored-nonce compression (ISSUE 14): outer/inner digit
+    decomposition with a per-group scalar round prefix, on BOTH
+    backends, plain and composed with the PR-13 sieve.  The adversarial
+    matrix mirrors TestSieve's: digit-class boundaries (9→10, 99→100,
+    999→1000), the u64 upper edge (where k=1 leaves nothing to factor
+    and the baseline fallback must ride along silently), duplicate
+    minima with the lowest-nonce tie-break through the factored pallas
+    kernel, threshold ties/prunes through its SMEM scratch, and a
+    multi-dispatch leg cross-checked per-nonce against digest_u64_py —
+    every case bit-exact."""
+
+    BACKENDS = [
+        ("xla", dict(backend="xla")),
+        ("pallas", dict(backend="pallas", interpret=True, batch=2)),
+    ]
+
+    @pytest.mark.parametrize("name,kw", BACKENDS, ids=[b[0] for b in BACKENDS])
+    @pytest.mark.parametrize(
+        "lo,hi",
+        [
+            (5, 15),       # 9→10: d=1 (k=1 → unfactorable fallback) + d=2
+            (93, 107),     # 99→100 digit-class boundary
+            (985, 1040),   # 999→1000
+        ],
+    )
+    def test_digit_class_boundaries(self, name, kw, lo, hi):
+        r = sweep_min_hash(
+            "cmu440", lo, hi, max_k=2, factored=True, sieve=False, **kw
+        )
+        assert (r.hash, r.nonce) == min_hash_range("cmu440", lo, hi)
+        assert r.lanes_swept == hi - lo + 1
+
+    @pytest.mark.parametrize("name,kw", BACKENDS, ids=[b[0] for b in BACKENDS])
+    @pytest.mark.parametrize("lo,hi", [(93, 107), (985, 1040)])
+    def test_factored_sieve_composition(self, name, kw, lo, hi):
+        # Pass 1 h0-only AND pass 2 resume from ONE shared group prefix.
+        r = sweep_min_hash(
+            "cmu440", lo, hi, max_k=2, factored=True, sieve=True, **kw
+        )
+        assert (r.hash, r.nonce) == min_hash_range("cmu440", lo, hi)
+
+    @pytest.mark.parametrize("name,kw", BACKENDS, ids=[b[0] for b in BACKENDS])
+    def test_u64_upper_edge(self, name, kw):
+        top = (1 << 64) - 1
+        r = sweep_min_hash(
+            "big", top - 50, top, max_k=1, factored=True, sieve=True, **kw
+        )
+        assert (r.hash, r.nonce) == min_hash_range("big", top - 50, top)
+
+    def test_multi_dispatch_threshold_tightens_bit_exact(self):
+        # Factored + sieve over many dispatches: the threshold tightens
+        # host-side between dispatches AND across the group loop inside
+        # each; the fold must stay bit-exact per-nonce via digest_u64_py
+        # (the layout machinery itself in the loop, like TestSieve's).
+        lo, hi = 100, 2099
+        r = sweep_min_hash(
+            "cmu440", lo, hi, backend="xla", max_k=2, batch=2,
+            factored=True, sieve=True,
+        )
+        best = None
+        for n in range(lo, hi + 1):
+            digits = str(n)
+            layout = build_layout(b"cmu440", len(digits))
+            cand = (digest_u64_py(layout, digits), n)
+            if best is None or cand < best:
+                best = cand
+        assert (r.hash, r.nonce) == best
+
+    # ---------------------------------------------------- direct kernel calls
+
+    def _tie_setup(self):
+        """Same fixture as TestSieve: one chunk row of [100, 199] for
+        'tie' (d=3, k=2 → k_in=1, 10 outer groups of 10 lanes)."""
+        import numpy as np
+
+        layout = build_layout(b"tie", 3)
+        h, n = min_hash_range("tie", 100, 199)
+        row = np.array(layout.tail_template, dtype=np.uint64)
+        dp = layout.digit_pos[0]
+        row[dp.word] |= np.uint64(ord("1") << dp.shift)
+        midstate = np.array(layout.midstate, dtype=np.uint32)
+        return layout, midstate, row, (h >> 32, h & 0xFFFFFFFF, n - 100)
+
+    def test_pallas_factored_threshold_tie_survives_and_prunes(self):
+        """h0 == threshold survives pass 1 through the factored sieve
+        kernel's per-group scratch path; threshold strictly below the
+        min prunes every group to the sentinel."""
+        import numpy as np
+
+        from bitcoin_miner_tpu.ops.pallas_sha256 import (
+            make_pallas_minhash_factored,
+        )
+
+        layout, midstate, row, (eh0, eh1, elane) = self._tie_setup()
+        fn = make_pallas_minhash_factored(
+            layout.n_tail_blocks, layout.digit_pos[1:], 2, 1,
+            batch=1, interpret=True, sieve=True,
+        )
+        tailcb = np.concatenate([row, [0, 100]]).astype(np.uint32)[None, :]
+        thresh = np.array([eh0 ^ 0x80000000], dtype=np.uint32).view(np.int32)
+        h0, h1, idx = fn(midstate, tailcb, thresh)
+        assert (int(h0), int(h1), int(idx)) == (eh0, eh1, elane)
+        from bitcoin_miner_tpu.ops.sweep import I32_MAX
+
+        thresh = np.array([(eh0 - 1) ^ 0x80000000], dtype=np.uint32).view(
+            np.int32
+        )
+        _h0, _h1, idx = fn(midstate, tailcb, thresh)
+        assert int(idx) == I32_MAX
+
+    def test_pallas_factored_duplicate_minimum_lowest_nonce(self):
+        """Duplicate rows tie on (h0, h1) everywhere; the factored
+        kernel's remapped global flat index must still resolve to row 0
+        — the outer/inner remap cannot reorder the tie-break."""
+        import numpy as np
+
+        from bitcoin_miner_tpu.ops.pallas_sha256 import (
+            make_pallas_minhash_factored,
+        )
+
+        layout, midstate, row, (eh0, eh1, _elane) = self._tie_setup()
+        fn = make_pallas_minhash_factored(
+            layout.n_tail_blocks, layout.digit_pos[1:], 2, 1,
+            batch=2, cpb=2, interpret=True, sieve=False,
+        )
+        tailcb = np.tile(
+            np.concatenate([row, [0, 100]]).astype(np.uint32), (2, 1)
+        )
+        h0, h1, idx = fn(midstate, tailcb)
+        assert (int(h0), int(h1)) == (eh0, eh1)
+        assert int(idx) < 100  # row 0, not the duplicate row 1
+
+    def test_xla_factored_matches_direct_kernel(self):
+        """The factored xla kernel body called directly (the sharded
+        tier re-traces exactly this fn inside shard_map) agrees with the
+        oracle's (h0, h1, lane) triple, runt bounds included."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bitcoin_miner_tpu.ops.sweep import make_kernel_body
+
+        layout, midstate, row, _ = self._tie_setup()
+        h, n = min_hash_range("tie", 130, 169)  # runt inside the chunk
+        kern = make_kernel_body(
+            layout.n_tail_blocks, layout.digit_pos[1:], 2, batch=1,
+            rolled=True, factored=1,
+        )
+        tail_const = row.astype(np.uint32)[None, :]
+        bounds = np.array([[30, 70]], dtype=np.int32)
+        h0, h1, idx = kern(
+            jnp.asarray(midstate), jnp.asarray(tail_const), jnp.asarray(bounds)
+        )
+        assert (int(h0), int(h1), int(idx)) == (h >> 32, h & 0xFFFFFFFF, n - 100)
 
 
 class TestPipelineLifecycle:

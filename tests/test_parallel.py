@@ -74,6 +74,63 @@ def test_sharded_pallas_interpret_digit_boundary():
     assert (r.hash, r.nonce) == min_hash_range("x", 95, 305)
 
 
+def test_sharded_per_shard_sieve_matches_oracle():
+    # Per-shard sieve (ISSUE 14 satellite): the sharded tier no longer
+    # forces the baseline kernel — each shard's pass 1 seeds from the
+    # replicated dispatch threshold ahead of the collective argmin
+    # cascade, and survivor-less shards contribute the sentinel the
+    # cascade orders last.  batch_per_device=2 over 8 devices with a
+    # digit-boundary range: later dispatches carry a tightened running
+    # min, so most shards prune to the sentinel and the fold must STILL
+    # be bit-exact, lowest-nonce ties included.
+    r = sweep_min_hash_sharded(
+        "cmu440", 1000, 2234, backend="xla", max_k=2, batch_per_device=2,
+        sieve=True,
+    )
+    assert (r.hash, r.nonce) == min_hash_range("cmu440", 1000, 2234)
+    assert r.lanes_swept == 2234 - 1000 + 1
+
+
+def test_sharded_per_shard_sieve_digit_boundary():
+    r = sweep_min_hash_sharded(
+        "x", 95, 305, backend="xla", max_k=1, batch_per_device=2, sieve=True
+    )
+    assert (r.hash, r.nonce) == min_hash_range("x", 95, 305)
+
+
+def test_sharded_pallas_interpret_per_shard_sieve():
+    # The flagship sharded composition: the dyn pallas SIEVE kernel under
+    # shard_map — each shard tightens its own local running min in SMEM
+    # scratch (the "per-shard local running-min") before the pmin cascade.
+    r = sweep_min_hash_sharded(
+        "cmu440", 1000, 2234, backend="pallas", interpret=True,
+        max_k=2, batch_per_device=2, sieve=True,
+    )
+    assert (r.hash, r.nonce) == min_hash_range("cmu440", 1000, 2234)
+
+
+def test_mesh_pipeline_per_shard_sieve_matches_oracle():
+    # SweepPipeline mesh mode threads the enqueue-time running-min into
+    # every sharded dispatch (sieve no longer pinned off in mesh mode).
+    from bitcoin_miner_tpu.ops.sweep import SweepPipeline
+
+    p = SweepPipeline(
+        backend="xla", mesh=default_mesh(8), max_k=2, batch=2,
+        host_lane_budget=0, sieve=True,
+    )
+    try:
+        futs = [
+            p.submit("cmu440", 1000, 2234),
+            p.submit("cmu440", 2235, 3499),
+        ]
+        wants = [("cmu440", 1000, 2234), ("cmu440", 2235, 3499)]
+        for f, (d, lo, hi) in zip(futs, wants):
+            r = f.result(timeout=300)
+            assert (r.hash, r.nonce) == min_hash_range(d, lo, hi), (d, lo, hi)
+    finally:
+        p.close()
+
+
 def test_sharded_matches_single_device_tier():
     from bitcoin_miner_tpu.ops.sweep import sweep_min_hash
 

@@ -534,3 +534,85 @@ class TestFairness:
         assert st["miners"] == 1 and st["idle_miners"] == 0
         # depth-2 pipeline: the lone miner holds two chunks.
         assert st["jobs"] == 1 and st["outstanding_chunks"] == 2
+
+
+class TestAdaptiveDepth:
+    """Adaptive pipeline depth (ISSUE 14 satellite, PR-10 carry-over):
+    the per-miner assignment window tracks the observed per-dispatch
+    device latency instead of the static 2 — deep enough to hide a
+    tunnelled TPU's dispatch+fetch latency, shallow when latency doesn't
+    warrant it (which also keeps enqueue-time sieve thresholds fresh).
+    The latency provider is injected so these stay deterministic."""
+
+    def _sched(self, latency, **kw):
+        return Scheduler(
+            validate_results=False,
+            min_chunk=10,
+            max_chunk=10,
+            target_chunk_seconds=0.5,
+            adaptive_depth=True,
+            dispatch_latency=lambda: latency,
+            **kw,
+        )
+
+    def test_static_without_flag(self):
+        s = Scheduler(validate_results=False)
+        assert s.effective_depth() == s.pipeline_depth == 2
+        s.tick(0.0)
+        assert s.effective_depth() == 2
+
+    def test_no_evidence_keeps_configured_depth(self):
+        s = self._sched(None)
+        s.tick(0.0)
+        assert s.effective_depth() == 2
+
+    def test_high_latency_deepens_window(self):
+        # p50 2s against a 0.5s chunk target wants 1 + ceil(4) = 5,
+        # clamped to depth_cap.
+        s = self._sched(2.0, depth_cap=4)
+        s.tick(0.0)
+        assert s.effective_depth() == 4
+
+    def test_low_latency_shallows_window_to_one(self):
+        # Sub-millisecond dispatches (in-process fleets): nothing to
+        # hide, so one chunk in flight — the freshest sieve thresholds.
+        s = self._sched(0.0)
+        s.tick(0.0)
+        assert s.effective_depth() == 1
+
+    def test_moderate_latency_keeps_two(self):
+        s = self._sched(0.2)  # ceil(0.4) = 1 -> depth 2, the old static
+        s.tick(0.0)
+        assert s.effective_depth() == 2
+
+    def test_depth_governs_assignment_window(self):
+        # With latency evidence saying depth 1, a lone miner holds ONE
+        # chunk; flip the evidence to 2s and the next tick re-deepens.
+        lat = {"v": 0.0}
+        s = Scheduler(
+            validate_results=False,
+            min_chunk=10,
+            max_chunk=10,
+            target_chunk_seconds=0.5,
+            adaptive_depth=True,
+            dispatch_latency=lambda: lat["v"],
+        )
+        s.tick(0.0)
+        s.miner_joined(1)
+        s.client_request(10, "a", 0, 99)
+        assert s.stats()["outstanding_chunks"] == 1
+        lat["v"] = 2.0
+        actions = s.tick(1.0)
+        # The deeper window back-fills the queue on the same tick.
+        assert s.stats()["outstanding_chunks"] >= 2
+        assert all(m.type == MsgType.REQUEST for _, m in actions)
+
+    def test_depth_adapt_counts_metric(self):
+        from bitcoin_miner_tpu.utils.metrics import METRICS
+
+        before = METRICS.get("sched.depth_adapt")
+        s = self._sched(2.0)
+        s.tick(0.0)
+        assert METRICS.get("sched.depth_adapt") == before + 1
+        s.tick(1.0)  # unchanged evidence: no second bump
+        assert METRICS.get("sched.depth_adapt") == before + 1

@@ -61,7 +61,7 @@ from .common import (
 
 PASS = "trace"
 
-FACTORY_RE = re.compile(r"(make|build).*(kernel|minhash|sieve|call)")
+FACTORY_RE = re.compile(r"(make|build).*(kernel|minhash|sieve|factored|call)")
 
 #: Default scan scope in repo mode: the accelerator layers.
 TRACE_SCAN_DIRS = (

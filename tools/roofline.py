@@ -132,7 +132,13 @@ for _name in ("lshift", "rshift"):
     setattr(_Tr, f"__{_name}__", lambda self, o: _op(self, o))
 
 
-def count_vector_ops(data: str, d: int, k: int, h0_only: bool = False) -> int:
+def count_vector_ops(
+    data: str,
+    d: int,
+    k: int,
+    h0_only: bool = False,
+    factored_k_in: "int | None" = None,
+) -> int:
     """Exact VPU op count per nonce for one full tail hash of ``data`` at
     digit count ``d`` with ``k`` in-kernel digits: the contrib-word ORs of
     the kernel's w assembly plus every vector op inside each block's
@@ -145,16 +151,28 @@ def count_vector_ops(data: str, d: int, k: int, h0_only: bool = False) -> int:
     every word of the dyn window is a vector (OR with a runtime contrib
     tile, zero or not), not just the d-class's own digit words — this is
     the dyn kernel's documented cost and must be in the op model or the
-    sustained-throughput estimate comes out biased low."""
+    sustained-throughput estimate comes out biased low.
+
+    ``factored_k_in`` (ISSUE 14) models the per-class STATIC factored
+    kernel instead: only the k_in INNER digit words are vector — the
+    outer digits are per-group SMEM scalars, the pre-inner-word round
+    prefix runs once per group on the scalar unit (which the tracer sees
+    automatically: every all-scalar sub-expression counts zero), and
+    there is no dyn window at all (ops/sweep.py ``_build_kernel`` on why
+    the factored form must be static)."""
     from bitcoin_miner_tpu.ops.pallas_sha256 import dyn_params
     from bitcoin_miner_tpu.ops.sha256 import build_layout, compress
 
     layout = build_layout(data.encode(), d)
-    window = dyn_params(layout, k)
-    if window is not None:
-        cwords = set(range(window[0], window[1] + 1))
-    else:  # d == k static fallback: only the digit words are vector
-        cwords = {p.word for p in layout.digit_pos[layout.digit_count - k :]}
+    if factored_k_in is not None:
+        split = layout.factor(k, factored_k_in)
+        cwords = {p.word for p in split.inner_pos}
+    else:
+        window = dyn_params(layout, k)
+        if window is not None:
+            cwords = set(range(window[0], window[1] + 1))
+        else:  # d == k static fallback: only the digit words are vector
+            cwords = {p.word for p in layout.digit_pos[layout.digit_count - k :]}
     state = tuple(_Tr(False) for _ in range(8))  # midstate scalars
     total = 0
     for b in range(layout.n_tail_blocks):
@@ -204,6 +222,47 @@ def sieve_op_report(data: str, d: int, k: int) -> dict:
     }
 
 
+def factored_op_report(data: str, d: int, k: int) -> dict:
+    """Per-pass op accounting for the FACTORED kernel (ISSUE 14) at the
+    default inner split (ops/sweep.py ``default_factor_k_in``), so the
+    claimed compression-side savings are auditable without TPU time.
+
+    The factored epilogue is op-for-op the baseline's (same valid mask —
+    the per-group bounds are scalar-clipped host bounds — same selects,
+    flips, idx add and running-min fold; the outer-digit patching, scalar
+    round prefix and group bookkeeping all live on the scalar unit), so
+    EPILOGUE_OPS / SIEVE_PASS1_EPILOGUE carry over unchanged and the
+    whole delta is the compression + assembly count: the inner-word-only
+    vector set drops the flagship 1-block shape from 3002 to 2910
+    (h0-only 3001 → 2909).  The reduction is reported against BOTH the
+    unfactored baseline and the PR-13 sieve pass-1 count — the
+    acceptance yardstick (3008.6 ops/lane on the flagship shape).
+    """
+    from bitcoin_miner_tpu.ops.sweep import default_factor_k_in
+
+    k_in = default_factor_k_in(k)
+    base = sieve_op_report(data, d, k)
+    full = count_vector_ops(data, d, k, factored_k_in=k_in)
+    h0 = count_vector_ops(data, d, k, h0_only=True, factored_k_in=k_in)
+    f_plain = full + EPILOGUE_OPS
+    f_pass1 = h0 + SIEVE_PASS1_EPILOGUE
+    return {
+        "k_in": k_in,
+        "k_out": k - k_in,
+        "compress_full_ops": full,
+        "compress_h0_ops": h0,
+        "factored_ops_per_lane": round(f_plain, 2),
+        "factored_sieve_pass1_ops_per_lane": round(f_pass1, 2),
+        # vs the unfactored kernels of the same sieve mode:
+        "savings_vs_baseline": round(
+            1 - f_plain / base["baseline_ops_per_lane"], 4
+        ),
+        "savings_vs_sieve_pass1": round(
+            1 - f_pass1 / base["sieve_pass1_ops_per_lane"], 4
+        ),
+    }
+
+
 def _rate(data: str, n: int) -> float:
     from bitcoin_miner_tpu.ops.sweep import sweep_min_hash
 
@@ -231,6 +290,8 @@ def main() -> int:
     if args.ops_only:
         rep = sieve_op_report(DATA_1BLK, 10, MAX_K)
         rep2 = sieve_op_report(DATA_2BLK, 10, MAX_K)
+        frep = factored_op_report(DATA_1BLK, 10, MAX_K)
+        frep2 = factored_op_report(DATA_2BLK, 10, MAX_K)
         print(
             f"sieve op accounting ({DATA_1BLK!r}, d=10, k={MAX_K}): pass 1 "
             f"{rep['sieve_pass1_ops_per_lane']} ops/lane vs baseline "
@@ -240,11 +301,27 @@ def main() -> int:
             file=sys.stderr,
         )
         print(
+            f"factored op accounting (k_in={frep['k_in']}): sieve pass 1 "
+            f"{frep['factored_sieve_pass1_ops_per_lane']} ops/lane vs the "
+            f"unfactored {rep['sieve_pass1_ops_per_lane']} -> "
+            f"{frep['savings_vs_sieve_pass1']:.1%} off the compression "
+            f"plateau (plain kernel {frep['factored_ops_per_lane']} vs "
+            f"{rep['baseline_ops_per_lane']}, "
+            f"{frep['savings_vs_baseline']:.1%})",
+            file=sys.stderr,
+        )
+        print(
             json.dumps(
                 {
                     "metric": "sieve_op_report",
                     "shape_1blk": {"data": DATA_1BLK, "d": 10, "k": MAX_K, **rep},
                     "shape_2blk": {"data": DATA_2BLK, "d": 10, "k": MAX_K, **rep2},
+                    "factored_1blk": {
+                        "data": DATA_1BLK, "d": 10, "k": MAX_K, **frep,
+                    },
+                    "factored_2blk": {
+                        "data": DATA_2BLK, "d": 10, "k": MAX_K, **frep2,
+                    },
                 }
             )
         )
@@ -302,6 +379,9 @@ def main() -> int:
                 # Per-pass sieve accounting for the flagship shape: what
                 # the measured rate's op model becomes with the sieve on.
                 "sieve": sieve_op_report(DATA_1BLK, 10, MAX_K),
+                # And the factored form's (ISSUE 14) — the compression-
+                # side lever the sieve audit named as the real plateau.
+                "factored": factored_op_report(DATA_1BLK, 10, MAX_K),
             }
         )
     )
