@@ -56,9 +56,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     rate: Optional[float] = None
     gossip_interval = 1.0
     forward_timeout = 15.0
+    # --async-ingress (ISSUE 15): serve the public port on the asyncio
+    # event-loop front end — O(1) threads in live conns (env spelling
+    # BMT_ASYNC_INGRESS, like apps.server; "" and "0" mean OFF).
+    async_public = os.environ.get("BMT_ASYNC_INGRESS", "") not in ("", "0")
     pos = []
     for a in argv[1:]:
-        if a.startswith("--cell="):
+        if a == "--async-ingress":
+            async_public = True
+        elif a.startswith("--cell="):
             cell = a.split("=", 1)[1]
         elif a.startswith("--fed-port="):
             fed_port = int(a.split("=", 1)[1])
@@ -128,11 +134,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             checkpoint_path=checkpoint_path,
             tick_interval=1.0,
             workload=wl,
+            async_public=async_public,
         )
+        # With the async ingress the public bind happens in start() (on
+        # the ingress loop); a busy port gets the same friendly message.
+        replica.start()
     except OSError as e:
         print(str(e))
         return 0
-    replica.start()
     print(
         f"Replica {cell} listening on port {replica.port} "
         f"(federation port {replica.fed_port})",

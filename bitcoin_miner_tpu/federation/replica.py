@@ -256,6 +256,7 @@ class Replica:
         port: int = 0,
         fed_port: int = 0,
         host: str = "127.0.0.1",
+        async_public: bool = False,
         params: Optional["lsp.Params"] = None,
         scheduler: Optional[Scheduler] = None,
         cache: Optional[ResultCache] = None,
@@ -289,7 +290,19 @@ class Replica:
         # Chaos identities: the public port is the cell name (partition a
         # whole cell), the federation port fed-<cell> (cut peer traffic),
         # gossip clients gossip-<cell>, forward clients fwd-<cell>.
-        self.public = lsp.Server(port, params, host=host, label=cell)
+        #
+        # ``async_public`` (ISSUE 15) serves the public port on the
+        # asyncio event-loop front end (apps.server.AsyncIngress) instead
+        # of the blocking facade + serve thread: binding then happens in
+        # :meth:`start` on the ingress loop, and thread count stays O(1)
+        # in live public conns.
+        self._async_public = bool(async_public)
+        self._host = host
+        self._public_port_arg = port
+        self.public = (
+            None if self._async_public
+            else lsp.Server(port, params, host=host, label=cell)
+        )
         self.fed = lsp.Server(fed_port, params, host=host, label=f"fed-{cell}")
         # The cell's range-fold workload (ISSUE 9) stamps every state
         # file below; every cell of one federation must agree.
@@ -355,6 +368,11 @@ class Replica:
         self._fwd_conns: set = set()  # guarded-by: lock
         self._down_lock = threading.Lock()
         self._down: Dict[str, float] = {}  # guarded-by: _down_lock
+        # ONE shared loop thread carries every forwarder worker's peer
+        # conns (ISSUE 15): the pool used to cost a loop thread PER
+        # cached conn (workers x peers), which multiplied thread counts
+        # instead of capacity as cells were added.  Created in start().
+        self._fwd_loop = None
         self._threads: List[threading.Thread] = []
         self._started = False
 
@@ -364,22 +382,38 @@ class Replica:
         """Spawn the serve loop, federation ingest, gossip daemon and
         forwarder pool as daemon threads; returns self."""
         self._started = True
-        t = threading.Thread(
-            target=server_mod.serve,
-            args=(self.public, self.router),
-            kwargs=dict(
+        if self._async_public:
+            self.public = server_mod.AsyncIngress(
+                self._public_port_arg,
+                scheduler=self.router,
+                params=self.params,
+                host=self._host,
+                label=self.cell,
                 lock=self.lock,
                 tick_interval=self._tick_interval,
                 checkpoint_path=self._checkpoint_path,
                 telemetry=self._telemetry,
                 log=self._log,
                 clock=self._clock,
-            ),
-            name=f"fed-serve-{self.cell}",
-            daemon=True,
-        )
-        t.start()
-        self._threads.append(t)
+            ).start()
+        else:
+            t = threading.Thread(
+                target=server_mod.serve,
+                args=(self.public, self.router),
+                kwargs=dict(
+                    lock=self.lock,
+                    tick_interval=self._tick_interval,
+                    checkpoint_path=self._checkpoint_path,
+                    telemetry=self._telemetry,
+                    log=self._log,
+                    clock=self._clock,
+                ),
+                name=f"fed-serve-{self.cell}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        self._fwd_loop = lsp.shared_loop(f"fwd-loop-{self.cell}")
         ti = threading.Thread(
             target=self._fed_ingest, name=f"fed-ingest-{self.cell}", daemon=True
         )
@@ -415,7 +449,8 @@ class Replica:
                         continue
         self.gossip.stop()
         try:
-            self.public.close()
+            if self.public is not None:
+                self.public.close()
         except lsp.LspError:
             pass
         try:
@@ -425,6 +460,11 @@ class Replica:
         for t in self._threads:
             t.join(timeout=3.0)
         self._threads = []
+        if self._fwd_loop is not None:
+            # After the forwarder workers have drained and closed their
+            # conns: the shared loop's owner stops it last.
+            self._fwd_loop.stop()
+            self._fwd_loop = None
 
     @property
     def port(self) -> int:
@@ -804,8 +844,11 @@ class Replica:
         if client is None:
             host, fport = self.peers[name]
             try:
+                # All workers' peer conns ride the ONE shared forwarder
+                # loop (ISSUE 15): a cached conn costs state, not a thread.
                 client = lsp.Client(
-                    host, fport, self.params, label=f"fwd-{self.cell}"
+                    host, fport, self.params, label=f"fwd-{self.cell}",
+                    loop=self._fwd_loop,
                 )
             except (lsp.LspError, OSError):
                 return None

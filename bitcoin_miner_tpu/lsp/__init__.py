@@ -19,13 +19,14 @@ from .errors import (
 )
 from .message import Message, MsgType
 from .params import Params
-from .sync import Client, Server
+from .sync import Client, Server, shared_loop
 
 __all__ = [
     "AsyncClient",
     "AsyncServer",
     "Client",
     "Server",
+    "shared_loop",
     "Message",
     "MsgType",
     "Params",

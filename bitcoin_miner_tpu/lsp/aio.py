@@ -235,6 +235,13 @@ class AsyncServer:
     def port(self) -> int:
         return self._endpoint.local_addr[1]
 
+    def conns_live(self) -> int:
+        """Live (handshaken, not yet finished) conns right now — the
+        ``gw.conns_live`` gauge source (ISSUE 15).  A plain ``len`` of
+        the conn table: atomic under the GIL, so the serve ticker may
+        read it from its own thread without a loop hop."""
+        return len(self._conns)
+
     def peer_host(self, conn_id: int) -> Optional[str]:
         """The remote host of a live connection, or None once it is gone.
         This is the stable per-client identity the serving layer binds

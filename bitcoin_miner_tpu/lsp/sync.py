@@ -131,24 +131,42 @@ class _LoopThread:
         self._thread.join(timeout=5)
 
 
+def shared_loop(name: str) -> _LoopThread:
+    """A caller-owned loop thread many sync facades can share (ISSUE 15):
+    pass it as ``Client(..., loop=...)`` so N conns cost ONE thread
+    instead of N — the federation forwarder pool and the loadgen conn
+    ramps ride this.  The caller owns the lifetime: ``stop()`` it after
+    closing every client that rides it (a client on a borrowed loop never
+    stops the loop itself)."""
+    return _LoopThread(name)
+
+
 class Client:
     """Blocking LSP client (API parity: lsp/client_api.go:6-30).
 
     ``Client(host, port, params)`` performs the handshake and raises
     CannotEstablishConnectionError after EpochLimit silent epochs.
+
+    ``loop`` (ISSUE 15) borrows a :func:`shared_loop` instead of spawning
+    a private loop thread: the conn's coroutines run on the shared loop
+    and ``close()`` leaves the loop alive for its owner to stop.
     """
 
     def __init__(
         self, host: str, port: int, params: Optional[Params] = None,
-        label: Optional[str] = None,
+        label: Optional[str] = None, loop: Optional[_LoopThread] = None,
     ) -> None:
-        self._lt = _LoopThread(f"lsp-client-{host}:{port}")
+        self._owns_loop = loop is None
+        self._lt = loop if loop is not None else _LoopThread(
+            f"lsp-client-{host}:{port}"
+        )
         try:
             self._c: AsyncClient = self._lt.run(
                 AsyncClient.connect(host, port, params, label=label)
             )
         except BaseException:
-            self._lt.stop()
+            if self._owns_loop:
+                self._lt.stop()
             raise
         # Conn-lifecycle trace events (ISSUE 6): in a chaos soak's trace
         # the connect/close pairs bracket each reconnect epoch, so the
@@ -174,14 +192,16 @@ class Client:
 
     def close(self) -> None:
         """Block until pending sends are acked (or the conn is lost).
-        Idempotent: a second close is a no-op."""
+        Idempotent: a second close is a no-op.  A borrowed shared loop
+        stays running for its owner."""
         trace.emit(None, "lsp", "close", conn=self._c.conn_id)
         try:
             self._lt.run(self._c.close())
         except ConnClosedError:
             return  # already closed
         finally:
-            self._lt.stop()
+            if self._owns_loop:
+                self._lt.stop()
 
 
 class Server:
@@ -203,6 +223,12 @@ class Server:
     @property
     def port(self) -> int:
         return self._s.port
+
+    def conns_live(self) -> int:
+        """Live conns right now (the ``gw.conns_live`` gauge source).
+        Same benign snapshot read as :meth:`AsyncServer.conns_live` — a
+        dict ``len`` is atomic under the GIL, so no loop hop."""
+        return self._s.conns_live()
 
     def peer_host(self, conn_id: int) -> Optional[str]:
         """The remote host of a live conn (the admission-control client

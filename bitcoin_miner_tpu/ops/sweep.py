@@ -543,7 +543,11 @@ def auto_tune(
         # half-fill a 2048-row batch and measured 1.79e9 delivered vs
         # 1.82e9 at 1024 — the scheduler-matched 1024 wins end-to-end.
         # xla default measured via bench.py --autotune on XLA:CPU: batch 4
-        # beat 8/16/32 by 14-128% (smaller schedule buffer, better cache).
+        # beat 8/16/32 by 14-128% (smaller schedule buffer, better cache);
+        # RE-MEASURED under the r14 factored default (ROADMAP PR-14
+        # follow-on c, BENCH_pr15.json): per-group buffers narrowed the
+        # gap but batch 4 still wins — 2.40M vs 2.37M (8), 1.49M (16),
+        # 1.21M (32) n/s — so the default stands.
         batch = 1024 if backend == "pallas" else 4
     if max_k is None:
         max_k = 6 if backend == "pallas" else 5

@@ -353,6 +353,10 @@ def format_quantiles(h) -> str:
 #:   fed.shed_holds            heartbeats held SHEDDING by flap-damping hysteresis
 #:   fed.peer_state            per-peer membership gauge (fed.peer_state.<peer>: 0 OK .. 4 DEAD)
 #:   gossip.retransmits        unacked delta spans resent by the ack-gap recovery
+#:   ingress.events            payloads dispatched on the asyncio ingress loop
+#:   ingress.conns_lost        conns the async ingress reaped after epoch loss
+#:   ingress.cross_thread_writes  off-loop writes hopped onto the ingress loop
+#:   gw.conns_live             live conns at the public serving transport (gauge)
 #:   miner.nonces              nonces swept by this process's miner loop
 #:   miner.reconnects          successful re-Joins after a lost server conn
 #:   miner.tier_downgrades     kernel tiers abandoned by the sweep watchdog

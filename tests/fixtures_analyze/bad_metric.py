@@ -13,6 +13,12 @@
 - ``fed.peer_state.fixture`` is a membership gauge (the
   ``fed.peer_state`` family is gauge-kind, ISSUE 12) but emitted via
   ``inc`` (``metric-kind-mismatch``);
+- ``gw.conns_live`` is the ingress live-conn gauge (the one gauge-kind
+  name under ``gw.*``, ISSUE 15) but emitted via ``inc``
+  (``metric-kind-mismatch``);
+- ``ingress.fixture_events`` is documented below but never emitted
+  (``metric-unused`` — pins the new ``ingress.*`` counter family in the
+  registry cross-check);
 - the computed-name ``inc`` cannot be registry-checked at all
   (``metric-dynamic-name``).
 """
@@ -35,6 +41,8 @@ class Metrics:  # stand-in so the fixture never imports the real package
 #:   hist.fixture_latency      a histogram name (observe-only kind)
 #:   fleet.fixture_sources     a fleet-view gauge (set_gauge-only kind)
 #:   fed.peer_state.fixture    a membership gauge (set_gauge-only kind)
+#:   gw.conns_live             the ingress live-conn gauge (set_gauge-only kind)
+#:   ingress.fixture_events    an ingress counter, documented but never emitted
 METRICS = Metrics()
 
 
@@ -43,4 +51,5 @@ def provoke_metric_drift(suffix: str) -> None:
     METRICS.inc("hist.fixture_latency")  # wrong emitter for a hist.* name
     METRICS.inc("fleet.fixture_sources")  # wrong emitter for a fleet.* gauge
     METRICS.inc("fed.peer_state.fixture")  # wrong emitter for a membership gauge
+    METRICS.inc("gw.conns_live")  # wrong emitter for the ingress conn gauge
     METRICS.inc("fixture." + suffix)  # dynamic name: unverifiable

@@ -194,6 +194,15 @@ def test_metrics_rules_fire_on_fixture():
     assert ("metric-kind-mismatch", "fed.peer_state.fixture") in {
         (f.rule, f.symbol) for f in findings
     }
+    # gw.conns_live is the ingress live-conn gauge (ISSUE 15) — the one
+    # gauge-kind name under gw.* — and the ingress.* counter family rides
+    # the same registry cross-check.
+    assert ("metric-kind-mismatch", "gw.conns_live") in {
+        (f.rule, f.symbol) for f in findings
+    }
+    assert ("metric-unused", "ingress.fixture_events") in {
+        (f.rule, f.symbol) for f in findings
+    }
 
 
 def test_metrics_pass_honors_metric_ok_declaration(tmp_path):

@@ -725,6 +725,31 @@ def test_forwarded_request_answers_bit_exact():
         fleet.close()
 
 
+def test_async_public_ingress_keeps_federation_contracts():
+    """ISSUE 15: replicas serving their PUBLIC port on the asyncio
+    event-loop front end (``async_public=True`` → apps.server.AsyncIngress
+    under the replica's own event lock) keep the federation contracts —
+    forwarded requests answer bit-exact, and a repeat at the forwarding
+    replica answers zero-chunk from the forward-populated cache, with the
+    forwarder pool's Results delivered through the ingress bridge's
+    cross-thread write path."""
+    METRICS.reset()
+    fleet = FedFleet(n=2, async_public=True)
+    try:
+        data, hi = "fedasync", 3000
+        home, other = fleet.home_and_other(data)
+        want = min_hash_range(data, 0, hi)
+        assert fleet.request_at(other, data, hi) == want
+        assert METRICS.get("federation.forwarded") >= 1
+        assert METRICS.get("federation.remote_results") >= 1
+        assigned = METRICS.get("sched.chunks_assigned")
+        assert fleet.request_at(other, data, hi) == want
+        assert fleet.request_at(home, data, hi) == want
+        assert METRICS.get("sched.chunks_assigned") == assigned
+    finally:
+        fleet.close()
+
+
 def test_duplicates_collapse_across_replicas():
     """Concurrent twins sprayed at BOTH replicas coalesce into one sweep
     on the home cell — the consistent-hash-routing acceptance shape."""

@@ -1,0 +1,172 @@
+"""Event-loop ingress tier-1 smoke (ISSUE 15): the asyncio serve front
+end (`apps.server.AsyncIngress`) holds hundreds of live conns at a FLAT
+process thread count — the axis the threaded facade stack is O(n) on —
+while serving bit-exact through the unchanged gateway plane, and a solved
+signature keeps answering with zero chunks assigned through the async
+path.  The shared-loop sync facade (`lsp.shared_loop`) costs ONE thread
+for N conns — the federation forwarder pool's new shape.
+
+Both suites run with the BMT_SANITIZE=1 machinery armed: the ingress
+loop joins the sanitizer's loop-shaped-resource graph (`ingress.loop.*`),
+so a bridge callback that could ABBA-deadlock against the event lock —
+or any off-lock policy-object access from the loop — raises here instead
+of hanging production.
+"""
+
+import asyncio
+import threading
+
+import pytest
+
+from bitcoin_miner_tpu import lsp
+from bitcoin_miner_tpu.apps import client as client_mod
+from bitcoin_miner_tpu.apps import miner as miner_mod
+from bitcoin_miner_tpu.apps import server as server_mod
+from bitcoin_miner_tpu.apps.scheduler import Scheduler
+from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+from bitcoin_miner_tpu.bitcoin.message import Message, MsgType
+from bitcoin_miner_tpu.gateway import Gateway, ResultCache, SpanStore
+from bitcoin_miner_tpu.utils import sanitize
+from bitcoin_miner_tpu.utils.metrics import METRICS
+
+pytestmark = pytest.mark.gateway
+
+# Long epochs: hundreds of idle conns' keepalive traffic scales with
+# 1/epoch, and nothing here probes loss timing.
+PARAMS = lsp.Params(epoch_limit=8, epoch_millis=500, window_size=5)
+
+
+async def _connect_n(port: int, n: int):
+    return list(
+        await asyncio.gather(
+            *(
+                lsp.AsyncClient.connect("127.0.0.1", port, PARAMS)
+                for _ in range(n)
+            )
+        )
+    )
+
+
+async def _ask_all(conns, data: str, lo: int, hi: int):
+    async def one(c):
+        c.write(Message.request(data, lo, hi).marshal())
+        while True:
+            payload = await asyncio.wait_for(c.read(), 60)
+            m = Message.unmarshal(payload)
+            if m is not None and m.type == MsgType.RESULT:
+                return (m.hash, m.nonce)
+
+    return await asyncio.gather(*(one(c) for c in conns))
+
+
+async def _close_all(conns):
+    await asyncio.gather(
+        *(asyncio.wait_for(c.close(), 5) for c in conns),
+        return_exceptions=True,
+    )
+
+
+def test_async_ingress_conn_scale_thread_flat():
+    """Hundreds of concurrent live conns on one ingress: the thread count
+    does NOT grow with conns (the acceptance axis), every conn completes
+    a bit-exact round trip, and the repeat wave of a solved signature
+    assigns zero new chunks through the async path."""
+    sanitize.force(True)
+    sanitize.reset_order_graph()
+    ingress = None
+    lt = None
+    conns: list = []
+    try:
+        engine = Gateway(
+            Scheduler(min_chunk=500),
+            cache=ResultCache(),
+            spans=SpanStore(),
+            rate=None,
+        )
+        ingress = server_mod.AsyncIngress(
+            0, scheduler=engine, params=PARAMS, tick_interval=0.05
+        ).start()
+        mc = lsp.Client("127.0.0.1", ingress.port, PARAMS)
+        threading.Thread(
+            target=miner_mod.run_miner,
+            args=(mc, miner_mod.make_search("cpu")),
+            daemon=True,
+        ).start()
+        # Solve once, so the conn-liveness wave below is pure cache hits
+        # (zero device work for the 240-way fan-in).
+        c = lsp.Client("127.0.0.1", ingress.port, PARAMS)
+        try:
+            got = client_mod.request_once(c, "ingress", 2500, timeout=120)
+        finally:
+            c.close()
+        want = min_hash_range("ingress", 0, 2500)
+        assert got == want
+        assigned_after_solve = METRICS.get("sched.chunks_assigned")
+
+        lt = lsp.shared_loop("test-aclients")
+
+        def run(coro):
+            return asyncio.run_coroutine_threadsafe(coro, lt.loop).result(
+                timeout=180
+            )
+
+        conns.extend(run(_connect_n(ingress.port, 120)))
+        threads_half = threading.active_count()
+        conns.extend(run(_connect_n(ingress.port, 120)))
+        threads_full = threading.active_count()
+        # The acceptance axis: +120 live conns, zero new threads.
+        assert threads_full <= threads_half
+        assert ingress.conns_live() >= len(conns)
+        # Every conn is genuinely live (full duplex round trip, oracle
+        # bit-exact) ...
+        results = run(_ask_all(conns, "ingress", 0, 2500))
+        assert all(g == want for g in results)
+        # ... and the whole wave was answered from the serving layer's
+        # cache: zero chunks assigned past the initial solve.
+        assert METRICS.get("sched.chunks_assigned") == assigned_after_solve
+    finally:
+        try:
+            if conns:
+                for s in range(0, len(conns), 80):
+                    asyncio.run_coroutine_threadsafe(
+                        _close_all(conns[s:s + 80]), lt.loop
+                    ).result(timeout=30)
+        finally:
+            if lt is not None:
+                lt.stop()
+            if ingress is not None:
+                ingress.close()
+            sanitize.force(None)
+
+
+def test_shared_loop_clients_cost_one_thread():
+    """N sync-facade conns on one `lsp.shared_loop` cost exactly ONE loop
+    thread (the federation forwarder pool's conn cache rides this), and
+    closing a borrowed-loop client leaves the loop running."""
+    sanitize.force(True)
+    sanitize.reset_order_graph()
+    server = lsp.Server(0, PARAMS)
+    lt = None
+    try:
+        lt = lsp.shared_loop("test-shared")
+        clients = [lsp.Client("127.0.0.1", server.port, PARAMS, loop=lt)]
+        # Baseline AFTER the first conn: the loop thread plus asyncio's
+        # lazily-spawned resolver-executor worker are one-time constants;
+        # the claim under test is O(1) threads in CONNS.
+        base = threading.active_count()
+        clients.extend(
+            lsp.Client("127.0.0.1", server.port, PARAMS, loop=lt)
+            for _ in range(5)
+        )
+        assert threading.active_count() == base
+        for c in clients:
+            c.close()
+        # The borrowed loop survives its clients: a fresh conn still works.
+        c = lsp.Client("127.0.0.1", server.port, PARAMS, loop=lt)
+        c.close()
+        assert threading.active_count() <= base
+    finally:
+        if lt is not None:
+            lt.stop()
+        server.close()
+        sanitize.force(None)

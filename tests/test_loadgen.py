@@ -55,6 +55,34 @@ def test_loadgen_workload_is_seeded():
     assert loadgen.build_overlap_workload(A) == loadgen.build_overlap_workload(A)
 
 
+def test_loadgen_fast_open_loop(capsys, monkeypatch):
+    """The --open-loop leg at --fast scale (ISSUE 15): Poisson arrivals
+    against the async event-loop ingress, every completed Result
+    bit-exact vs the oracle (the tool raises otherwise), shed rate and
+    latency quantiles stamped, and the repeat/sub-range zero-chunk
+    probes still true THROUGH the async path.  BMT_SANITIZE=1 arms the
+    race machinery over the ingress bridge for the whole run."""
+    monkeypatch.setenv("BMT_SANITIZE", "1")
+    loadgen = _load_tool()
+    rc = loadgen.main(["--open-loop", "30", "--fast", "--miners", "2"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "loadgen_open_loop_completed_per_sec"
+    assert out["mode"] == "open-loop" and out["ingress"] == "async"
+    ol = out["open_loop"]
+    # Open-loop accounting is exhaustive: every Poisson arrival completed,
+    # failed (shed/timed out), or was cancelled at drain end (a wrong
+    # answer would have raised above).
+    assert ol["offered"] == ol["completed"] + ol["failed"] + ol["undrained"]
+    assert ol["completed"] > 0 and ol["wrong"] == 0 and ol["undrained"] == 0
+    assert 0.0 <= ol["shed_rate"] <= 1.0
+    assert ol["latency_s"]["count"] == ol["completed"]
+    assert ol["latency_s"]["p99"] >= ol["latency_s"]["p50"] >= 0.0
+    # The serving layer's reuse machinery survives the async bridge.
+    assert out["repeat_zero_chunks"] is True
+    assert out["subrange_zero_chunks"] is True
+
+
 @pytest.mark.intervals
 def test_loadgen_fast_overlap_interval_store(capsys):
     """The --overlap leg at --fast scale (ISSUE 5): nested/overlapping

@@ -62,9 +62,11 @@ _METRICS_ASSIGN = re.compile(r"^METRICS\s*=", re.MULTILINE)
 def _name_kind(name: str) -> str:
     if name.startswith("hist."):
         return "hist"
-    if name.startswith(("gauge.", "fleet.", "fed.peer_state")):
+    if name.startswith(("gauge.", "fleet.", "fed.peer_state", "gw.conns_live")):
         # fed.peer_state[.<peer>] is the per-peer membership gauge family
-        # (ISSUE 12); the rest of fed.* stays counter-kind.
+        # (ISSUE 12); the rest of fed.* stays counter-kind.  gw.conns_live
+        # is the ingress live-conn gauge (ISSUE 15) — the only gauge-kind
+        # name under gw.*.
         return "gauge"
     return "counter"
 

@@ -937,6 +937,189 @@ def run_dispatch_compare(args) -> int:
     return 0
 
 
+def run_depth_compare(args) -> int:
+    """The --adaptive-depth arbitration leg (ISSUE 15 satellite, ROADMAP
+    PR-14 follow-on d): the SAME job batch through the same in-process
+    fleet twice — static 2-deep assignment windows vs ``adaptive_depth``
+    re-sizing off the observed ``hist.device_dispatch_s`` p50 — with the
+    miners on a SIEVE-ENABLED jax pipeline (``SweepPipeline(backend=
+    "xla", sieve=True)``), since threshold freshness under shallow
+    windows is the effect being arbitrated.  Prints one JSON line with
+    the same-seed pair; the default only flips if the adaptive leg wins
+    it."""
+    import threading
+
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.apps import client as client_mod
+    from bitcoin_miner_tpu.apps import miner as miner_mod
+    from bitcoin_miner_tpu.apps import server as server_mod
+    from bitcoin_miner_tpu.apps.scheduler import Scheduler
+    from bitcoin_miner_tpu.gateway import Gateway, SpanStore
+    from bitcoin_miner_tpu.utils import sanitize
+    from bitcoin_miner_tpu.utils.metrics import METRICS
+
+    min_hash_range = WORKLOAD.min_range
+    params = lsp.Params(10, 200, 5)
+
+    class _SievePipelineSearch:
+        """The miner's async search on the sieve-enabled jax tier: the
+        depth window under test gates how stale each dispatch's enqueued
+        sieve threshold is."""
+
+        def __init__(self) -> None:
+            from concurrent.futures import Future
+
+            from bitcoin_miner_tpu.ops.sweep import SweepPipeline
+
+            self._Future = Future
+            self._p = SweepPipeline(backend="xla", sieve=True)
+
+        def submit(self, data, lower, upper):
+            out = self._Future()
+
+            def _done(src) -> None:
+                e = src.exception()
+                if e is not None:
+                    out.set_exception(e)
+                else:
+                    r = src.result()
+                    out.set_result((r.hash, r.nonce))
+
+            self._p.submit(data, lower, upper).add_done_callback(_done)
+            return out
+
+        def close(self) -> None:
+            self._p.close()
+
+    def leg(tag: str, adaptive: bool) -> dict:
+        # Fresh registry per leg: hist.device_dispatch_s is cumulative
+        # (histograms have no delta view), and the adaptive leg must make
+        # its depth decisions from ITS OWN cold-start samples — a warm
+        # cross-leg p50 is evidence a cold production server never gets,
+        # and the stamped per-leg dispatch_p50_s would otherwise mix legs.
+        METRICS.reset()
+        before = METRICS.snapshot()
+        server = lsp.Server(0, params, label="server")
+        sched = Scheduler(adaptive_depth=adaptive)
+        gw = Gateway(sched, rate=None, spans=SpanStore())
+        lock = sanitize.make_lock(f"depth-compare.{tag}")
+        threading.Thread(
+            target=server_mod.serve,
+            args=(server, gw),
+            kwargs={"tick_interval": 0.1, "lock": lock},
+            daemon=True,
+        ).start()
+        searches = [_SievePipelineSearch() for _ in range(args.dp_miners)]
+        for i, s in enumerate(searches):
+            mc = lsp.Client("127.0.0.1", server.port, params,
+                            label=f"miner-{i}")
+            threading.Thread(
+                target=miner_mod.run_miner, args=(mc, s), daemon=True
+            ).start()
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                with lock:
+                    if gw.stats()["miners"] == args.dp_miners:
+                        break
+                time.sleep(0.05)
+            else:
+                raise RuntimeError(f"{tag}: miners never joined")
+            jobs = [
+                (f"depth-{tag}-{i}", args.dp_nonces - 1)
+                for i in range(args.dp_jobs)
+            ]
+            results: dict = {}
+            cursor = [0]
+            qlock = threading.Lock()
+
+            def worker(w: int) -> None:
+                while True:
+                    with qlock:
+                        if cursor[0] >= len(jobs):
+                            return
+                        i = cursor[0]
+                        cursor[0] += 1
+                    data, mx = jobs[i]
+                    results[data] = client_mod.request_with_retry(
+                        "127.0.0.1", server.port, data, mx,
+                        retries=4, backoff_base=0.1, params=params,
+                        label=f"client-{tag}-{w}",
+                    )
+
+            t0 = time.monotonic()
+            workers = [
+                threading.Thread(target=worker, args=(w,), daemon=True)
+                for w in range(max(1, args.dp_clients))
+            ]
+            for t in workers:
+                t.start()
+            for t in workers:
+                t.join(timeout=args.dp_deadline)
+            wall = time.monotonic() - t0
+            if any(t.is_alive() for t in workers):
+                raise RuntimeError(f"{tag}: batch exceeded {args.dp_deadline}s")
+            for data, mx in jobs:
+                want = min_hash_range(data, 0, mx)
+                if results.get(data) != want:
+                    raise RuntimeError(
+                        f"{tag}: {data} got {results.get(data)}, want {want}"
+                    )
+        finally:
+            server.close()
+            # Tear the leg's device pipelines down HERE, not on the
+            # miners' epoch-loss schedule (~2 s after the close): the
+            # next leg must not share wall time with this leg's pipeline
+            # worker threads (the same cross-leg hygiene as the METRICS
+            # reset above).  SweepPipeline.close is idempotent with the
+            # miner loop's own close-on-exit.
+            for s in searches:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+            time.sleep(2.5)  # epoch-loss window: miner threads fully exit
+        after = METRICS.snapshot()
+        h = METRICS.histogram("hist.device_dispatch_s")
+        snap = h.snapshot() if h is not None else {}
+        return {
+            "wall_s": round(wall, 3),
+            "jobs_per_sec": round(args.dp_jobs / wall, 3),
+            "depth_adapts": after.get("sched.depth_adapt", 0)
+            - before.get("sched.depth_adapt", 0),
+            "dispatch_p50_s": round(snap.get("p50", 0.0), 6),
+        }
+
+    static = leg("static", adaptive=False)
+    adaptive = leg("adaptive", adaptive=True)
+    speedup = (
+        round(adaptive["jobs_per_sec"] / static["jobs_per_sec"], 3)
+        if static["jobs_per_sec"] else None
+    )
+    log(f"static:   {static}")
+    log(f"adaptive: {adaptive}")
+    log(f"speedup: {speedup}x")
+    print(
+        json.dumps(
+            {
+                "metric": "adaptive_depth_speedup",
+                "value": speedup,
+                "unit": "x vs static 2-deep windows",
+                "workload": WORKLOAD.name,
+                "backend": "xla",
+                "sieve": True,
+                "jobs": args.dp_jobs,
+                "job_nonces": args.dp_nonces,
+                "miners": args.dp_miners,
+                "static": static,
+                "adaptive": adaptive,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nonces", type=int, default=2 * 10**10)
@@ -1054,6 +1237,20 @@ def main() -> int:
                     "healthy miners")
     ap.add_argument("--dc-deadline", type=float, default=120.0)
     ap.add_argument(
+        "--depth-compare",
+        action="store_true",
+        help="adaptive pipeline-depth arbitration (ISSUE 15 satellite): "
+        "static 2-deep vs --adaptive-depth windows on a sieve-enabled "
+        "xla fleet, same job batch; prints its own JSON line and exits",
+    )
+    ap.add_argument("--dp-jobs", type=int, default=6,
+                    help="jobs per depth-compare leg")
+    ap.add_argument("--dp-nonces", type=int, default=2_000_000,
+                    help="nonces per depth-compare job")
+    ap.add_argument("--dp-miners", type=int, default=2)
+    ap.add_argument("--dp-clients", type=int, default=2)
+    ap.add_argument("--dp-deadline", type=float, default=300.0)
+    ap.add_argument(
         "--federation",
         type=int,
         default=0,
@@ -1087,6 +1284,9 @@ def main() -> int:
                     f"{sorted(standard_scenarios())}"
                 )
         return run_dispatch_compare(args)
+
+    if args.depth_compare:
+        return run_depth_compare(args)
 
     if args.federation:
         return run_federation_bench(args)

@@ -30,9 +30,27 @@ never-issued, fully covered SUB-RANGE of solved work answers with zero
 chunks assigned.  The JSON line reports both legs' `swept_nonces` and
 their reduction (the BENCH_pr5.json artifact).
 
+`--open-loop RATE` (ISSUE 15) replaces the N closed-loop client threads
+with **open-loop** load: Poisson arrivals at RATE requests/sec for
+`--duration` seconds, each arrival an independent conn+request+close on
+one shared asyncio loop against the event-loop ingress
+(`apps.server.AsyncIngress`).  Closed-loop clients slow down when the
+server does — they can never overload it; open-loop is how production
+traffic actually arrives, so shed rate, p99 under saturation and the
+failed fraction are finally measurable.
+
+`--conn-scale` (ISSUE 15) is the ingress bench pair on the same seeded
+workload: a **threaded-facade** leg (blocking `serve` + one loop thread
+per conn, open-loop arrivals via thread-per-request) vs an **async
+ingress** leg (`AsyncIngress` + AsyncClient conns multiplexed on ONE
+loop), each ramping live conns and then taking open-loop load — live
+conns, process thread counts (flat-in-conns for async), RSS, shed rate
+and p99 stamped per leg, plus the repeat/sub-range zero-chunk probes
+through the async path (the BENCH_pr15.json artifact).
+
 Usage: python tools/loadgen.py [--fast] [--overlap] [--clients N]
        [--jobs N] [--dup F] [--max-nonce N] [--miners N] [--no-baseline]
-       [--seed N]
+       [--seed N] [--open-loop RATE] [--duration S] [--conn-scale]
 """
 
 from __future__ import annotations
@@ -250,6 +268,34 @@ def run_leg(
     }
 
 
+def _covered_subrange(spans_store, jobs, errors):
+    """A NEVER-ISSUED strict sub-range of the widest issued signature
+    that the interval store fully covers, or None (with the reason
+    appended to ``errors``).  Candidates are built from the solved-span
+    geometry: prefixes ending at a span boundary are covered whenever
+    the spans are contiguous; prefixes/suffixes cut AT a recorded argmin
+    keep the boundary span answerable by construction.  Each candidate
+    is re-verified through the planner itself before use."""
+    issued = set(jobs)
+    data, lo, hi = max(jobs, key=lambda s: s[2] - s[1])
+    span_map = spans_store._maps.get(data)
+    if span_map is None:
+        errors.append(f"no solved spans recorded for {data!r}")
+        return None
+    for s_lo, s_hi, _h, n in span_map.spans():
+        for cand in ((lo, s_hi), (lo, n), (n, hi)):
+            qlo, qhi = cand
+            if not (lo <= qlo <= qhi <= hi) or (qlo, qhi) == (lo, hi):
+                continue
+            if (data, qlo, qhi) in issued:
+                continue
+            best, gaps = spans_store.cover(data, qlo, qhi)
+            if not gaps and best is not None:
+                return (data, qlo, qhi)
+    errors.append("no fully covered strict sub-range found to probe")
+    return None
+
+
 def _subrange_probe(engine, server, params, jobs, errors, oracle_fn):
     """The ISSUE 5 acceptance probe: find a NEVER-ISSUED strict sub-range
     of the widest solved signature that the interval store fully covers,
@@ -261,44 +307,20 @@ def _subrange_probe(engine, server, params, jobs, errors, oracle_fn):
     from bitcoin_miner_tpu.apps import client as client_mod
     from bitcoin_miner_tpu.utils.metrics import METRICS
 
-    issued = set(jobs)
-    data, lo, hi = max(jobs, key=lambda s: s[2] - s[1])
-    # Candidate sub-ranges built from the solved-span geometry: prefixes
-    # ending at a span boundary are covered whenever the spans are
-    # contiguous; prefixes/suffixes cut AT a recorded argmin keep the
-    # boundary span answerable by construction.  Each candidate is
-    # re-verified through the planner itself before use.
-    span_map = engine.spans._maps.get(data)
-    if span_map is None:
-        errors.append(f"no solved spans recorded for {data!r}")
+    cand = _covered_subrange(engine.spans, jobs, errors)
+    if cand is None:
         return False
-    sub = None
-    for s_lo, s_hi, _h, n in span_map.spans():
-        for cand in ((lo, s_hi), (lo, n), (n, hi)):
-            qlo, qhi = cand
-            if not (lo <= qlo <= qhi <= hi) or (qlo, qhi) == (lo, hi):
-                continue
-            if (data, qlo, qhi) in issued:
-                continue
-            best, gaps = engine.spans.cover(data, qlo, qhi)
-            if not gaps and best is not None:
-                sub = (qlo, qhi)
-                break
-        if sub is not None:
-            break
-    if sub is None:
-        errors.append("no fully covered strict sub-range found to probe")
-        return False
+    data, qlo, qhi = cand
     assigned_before = METRICS.get("sched.chunks_assigned")
     c = lsp.Client("127.0.0.1", server.port, params)
     try:
-        got = client_mod.request_once(c, data, sub[1], lower=sub[0])
+        got = client_mod.request_once(c, data, qhi, lower=qlo)
     finally:
         c.close()
-    want = oracle_fn(data, sub[0], sub[1])
+    want = oracle_fn(data, qlo, qhi)
     if got != want:
         errors.append(
-            f"subrange probe ({data},{sub[0]},{sub[1]}): got {got}, want {want}"
+            f"subrange probe ({data},{qlo},{qhi}): got {got}, want {want}"
         )
     ok = METRICS.get("sched.chunks_assigned") == assigned_before
     if not ok:
@@ -546,6 +568,566 @@ def _cross_replica_probe(
     return ok and got == want
 
 
+# --------------------------------------------------------------------------
+# Event-loop ingress benches (ISSUE 15): open-loop load + conn-scale pair
+# --------------------------------------------------------------------------
+
+
+def _rss_kb() -> int:
+    """Current resident set (kB) — per-leg, unlike ru_maxrss which only
+    ever grows across a process's legs."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        pass
+    import resource
+    import sys as _sys
+
+    rss = int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+    # Fallback is a lifetime MAX, not the current figure — and Darwin
+    # reports ru_maxrss in bytes where Linux uses kB.
+    return rss // 1024 if _sys.platform == "darwin" else rss
+
+
+def _serving_stack(kind: str, args, tick_interval: float = 0.05):
+    """One in-process serving cell for the ingress benches: gateway-
+    wrapped scheduler, ``--miners`` cpu-tier miners, and the requested
+    transport shell — ``"threaded"`` (blocking facade + serve thread) or
+    ``"async"`` (the event-loop AsyncIngress).  Returns
+    ``(params, port, engine, close_fn)``."""
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.apps import miner as miner_mod
+    from bitcoin_miner_tpu.apps import server as server_mod
+    from bitcoin_miner_tpu.apps.scheduler import Scheduler
+    from bitcoin_miner_tpu.gateway import Gateway, ResultCache, SpanStore
+
+    # Long epochs: 10k-conn keepalive traffic scales with 1/epoch, and
+    # the conn-scale leg's point is holding conns, not probing loss fast.
+    params = lsp.Params(epoch_limit=8, epoch_millis=500, window_size=5)
+    engine = Gateway(
+        Scheduler(min_chunk=args.min_chunk, workload=args.wl),
+        cache=ResultCache(capacity=args.cache_size),
+        spans=SpanStore(),
+        # All loopback clients share one peer addr (see run_leg); the
+        # overload lever here is the bounded admission queue instead.
+        rate=None,
+        max_active=args.max_active,
+        max_queued=args.ol_queue,
+    )
+    if kind == "async":
+        ingress = server_mod.AsyncIngress(
+            0, scheduler=engine, params=params, tick_interval=tick_interval
+        ).start()
+        port, close_fn = ingress.port, ingress.close
+    else:
+        server = lsp.Server(0, params)
+        threading.Thread(
+            target=server_mod.serve,
+            args=(server, engine),
+            kwargs={"tick_interval": tick_interval},
+            daemon=True,
+        ).start()
+        port, close_fn = server.port, server.close
+    search = miner_mod.make_search("cpu", workload=args.wl)
+    for _ in range(args.miners):
+        mc = lsp.Client("127.0.0.1", port, params)
+        threading.Thread(
+            target=miner_mod.run_miner, args=(mc, search), daemon=True
+        ).start()
+    return params, port, engine, close_fn
+
+
+async def _request_result(c, data, lo, hi, timeout):
+    """Write one Request on an open AsyncClient conn and read frames
+    until its RESULT arrives; None on loss/shed/timeout.  The single
+    wire-probe loop every async bench path shares."""
+    import asyncio as _aio
+
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.bitcoin.message import Message, MsgType
+
+    try:
+        c.write(Message.request(data, lo, hi).marshal())
+        while True:
+            payload = await _aio.wait_for(c.read(), timeout)
+            m = Message.unmarshal(payload)
+            if m is not None and m.type == MsgType.RESULT:
+                return (m.hash, m.nonce)
+    except (lsp.LspError, _aio.TimeoutError):
+        return None
+
+
+async def _ol_one_async(port, params, sig, oracle, hist, stats, errors, timeout):
+    """One open-loop arrival on the shared client loop: fresh conn,
+    one request, read the Result, close.  A conn the gateway sheds (or
+    that times out under saturation) counts ``failed`` — the client-side
+    view; the authoritative shed count is the gateway.shed delta."""
+    import asyncio as _aio
+    import time as _t
+
+    from bitcoin_miner_tpu import lsp
+
+    data, lo, hi = sig
+    t0 = _t.monotonic()
+    try:
+        c = await _aio.wait_for(
+            lsp.AsyncClient.connect("127.0.0.1", port, params), timeout
+        )
+    except Exception:
+        stats["failed"] += 1
+        return
+    try:
+        got = await _request_result(c, data, lo, hi, timeout)
+    finally:
+        try:
+            await _aio.wait_for(c.close(), 2.0)
+        except Exception:
+            pass
+    if got is None:
+        stats["failed"] += 1
+    elif got != oracle[sig]:
+        stats["wrong"] += 1
+        errors.append(f"open-loop {sig}: got {got}, want {oracle[sig]}")
+    else:
+        stats["completed"] += 1
+        hist.observe(_t.monotonic() - t0)
+
+
+async def _ol_async(port, params, jobs, oracle, rate, duration, rng, hist,
+                    stats, errors, timeout):
+    """Poisson arrivals at ``rate``/s for ``duration``s, each an
+    independent task on the one client loop — open-loop: the arrival
+    process never waits for the server."""
+    import asyncio as _aio
+    import time as _t
+
+    tasks: set = set()
+    i = 0
+    end = _t.monotonic() + duration
+    while _t.monotonic() < end:
+        sig = jobs[i % len(jobs)]
+        i += 1
+        stats["offered"] += 1
+        t = _aio.ensure_future(
+            _ol_one_async(port, params, sig, oracle, hist, stats, errors, timeout)
+        )
+        tasks.add(t)
+        t.add_done_callback(tasks.discard)
+        await _aio.sleep(rng.expovariate(rate))
+    if tasks:
+        # Drain: every in-flight request's own deadline (``timeout``) is
+        # shorter than this wait, so stragglers here mean a wedged conn —
+        # cancel them and let the cancellations finalize, then reconcile
+        # ``undrained`` from the totals (a task finishing between wait()'s
+        # snapshot and a naive len(pending) count would otherwise be
+        # counted twice: once in completed/failed, once as undrained).
+        _done, pending = await _aio.wait(set(tasks), timeout=timeout + 5)
+        for t in pending:
+            t.cancel()
+        if pending:
+            await _aio.gather(*pending, return_exceptions=True)
+    stats["undrained"] = max(
+        0,
+        stats["offered"] - stats["completed"] - stats["failed"] - stats["wrong"],
+    )
+
+
+def _ol_threaded(port, params, jobs, oracle, rate, duration, rng, hist,
+                 stats, stats_lock, errors, timeout, max_threads):
+    """The threaded-facade open-loop generator: thread-per-arrival,
+    capped at ``max_threads`` live request threads.  An arrival landing
+    on a saturated pool is turned away at the CLIENT
+    (``client_saturated``) — the thread-stack failure mode the async
+    ingress exists to remove."""
+    import time as _t
+
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.apps import client as client_mod
+
+    sem = threading.Semaphore(max_threads)
+    threads = []
+
+    def one(sig):
+        data, lo, hi = sig
+        t0 = _t.monotonic()
+        got = None
+        try:
+            try:
+                c = lsp.Client("127.0.0.1", port, params)
+            except (lsp.LspError, OSError):
+                return
+            try:
+                got = client_mod.request_once(c, data, hi, lower=lo, timeout=timeout)
+            except TimeoutError:
+                got = None
+            finally:
+                try:
+                    c.close()
+                except lsp.LspError:
+                    pass
+        finally:
+            with stats_lock:
+                if got is None:
+                    stats["failed"] += 1
+                elif got != oracle[sig]:
+                    stats["wrong"] += 1
+                    errors.append(f"open-loop {sig}: got {got}, want {oracle[sig]}")
+                else:
+                    stats["completed"] += 1
+                    hist.observe(_t.monotonic() - t0)
+            sem.release()
+
+    i = 0
+    end = _t.monotonic() + duration
+    while _t.monotonic() < end:
+        sig = jobs[i % len(jobs)]
+        i += 1
+        with stats_lock:
+            stats["offered"] += 1
+        if not sem.acquire(blocking=False):
+            with stats_lock:
+                stats["client_saturated"] += 1
+        else:
+            th = threading.Thread(target=one, args=(sig,), daemon=True)
+            th.start()
+            threads.append(th)
+            if len(threads) >= 2 * max_threads:
+                # Prune finished threads as we go: at saturation rates the
+                # list (and the leg's own RSS stamp) must not grow with
+                # every arrival of the whole measurement window.
+                threads = [t for t in threads if t.is_alive()]
+        _t.sleep(rng.expovariate(rate))
+    for th in threads:
+        th.join(timeout=timeout + 5)
+
+
+def _open_loop_phase(kind, port, params, jobs, oracle, args, errors, lt=None):
+    """Run one open-loop measurement against an already-serving stack and
+    return its stamp: offered/completed/failed counts, the authoritative
+    gateway.shed delta and shed rate, and the completed-request latency
+    quantiles (p99-under-saturation is the number closed-loop clients can
+    never measure)."""
+    import asyncio as _aio
+
+    from bitcoin_miner_tpu.utils.metrics import METRICS, Histogram
+
+    rng = random.Random(args.seed + 1)
+    hist = Histogram()
+    stats = {
+        "offered": 0, "completed": 0, "failed": 0, "wrong": 0,
+        "client_saturated": 0, "undrained": 0,
+    }
+    stats_lock = threading.Lock()
+    shed_before = METRICS.get("gateway.shed")
+    if kind == "async":
+        fut = _aio.run_coroutine_threadsafe(
+            _ol_async(port, params, jobs, oracle, args.open_loop,
+                      args.duration, rng, hist, stats, errors,
+                      args.ol_timeout),
+            lt.loop,
+        )
+        fut.result(timeout=args.duration + args.ol_timeout + 30)
+    else:
+        _ol_threaded(port, params, jobs, oracle, args.open_loop,
+                     args.duration, rng, hist, stats, stats_lock, errors,
+                     args.ol_timeout, args.ol_max_threads)
+    if stats["wrong"]:
+        errors.append(f"{stats['wrong']} open-loop result(s) failed the oracle")
+    shed = METRICS.get("gateway.shed") - shed_before
+    lat = hist.snapshot()
+    return {
+        "rate": args.open_loop,
+        "duration_s": args.duration,
+        **stats,
+        "shed": shed,
+        "shed_rate": round(shed / stats["offered"], 4) if stats["offered"] else 0.0,
+        "latency_s": {
+            "p50": round(lat["p50"], 6),
+            "p95": round(lat["p95"], 6),
+            "p99": round(lat["p99"], 6),
+            "count": int(lat["count"]),
+        },
+    }
+
+
+def _async_probes(engine, port, params, lt, jobs, oracle, args, errors):
+    """The zero-chunk acceptance probes THROUGH the async path (ISSUE 15
+    acceptance: the bridge must not have broken the serving layer's reuse
+    machinery): an exact repeat of a solved signature and a never-issued
+    fully-covered sub-range, both answering bit-exact with zero chunks
+    assigned, via AsyncClient conns on the shared loop."""
+    import asyncio as _aio
+
+    from bitcoin_miner_tpu.utils.metrics import METRICS, Histogram
+
+    h = Histogram()  # throwaway latency sink for the probe helper
+
+    def ask(sig):
+        stats = {"offered": 0, "completed": 0, "failed": 0, "wrong": 0,
+                 "client_saturated": 0}
+        probe_oracle = {sig: oracle.get(sig, args.oracle_fn(*sig))}
+        _aio.run_coroutine_threadsafe(
+            _ol_one_async(
+                port, params, sig, probe_oracle, h, stats, errors,
+                args.ol_timeout,
+            ),
+            lt.loop,
+        ).result(timeout=args.ol_timeout + 10)
+        return stats["completed"] == 1
+
+    solved = [s for s in jobs if engine.cache.get(s) is not None]
+    if not solved:
+        errors.append("no solved signature to repeat-probe")
+        return None, None
+    repeat_sig = solved[0]
+    assigned = METRICS.get("sched.chunks_assigned")
+    ok = ask(repeat_sig)
+    repeat_zero = ok and METRICS.get("sched.chunks_assigned") == assigned
+    if not repeat_zero:
+        errors.append("async repeat probe missed the cache or failed")
+    cand = _covered_subrange(engine.spans, jobs, errors)
+    if cand is None:
+        return repeat_zero, None
+    data, qlo, qhi = cand
+    assigned = METRICS.get("sched.chunks_assigned")
+    ok = ask((data, qlo, qhi))
+    sub_zero = ok and METRICS.get("sched.chunks_assigned") == assigned
+    if not sub_zero:
+        errors.append("async subrange probe assigned chunks (spans missed)")
+    return repeat_zero, sub_zero
+
+
+def _open_loop_main(jobs, distinct, args, oracle) -> int:
+    """The standalone --open-loop bench: Poisson arrivals against the
+    async ingress, one JSON line (`--fast` gates tier-1)."""
+    from bitcoin_miner_tpu import lsp
+
+    errors: list = []
+    params, port, engine, close_fn = _serving_stack("async", args)
+    lt = lsp.shared_loop("loadgen-aclients")
+    try:
+        stamp = _open_loop_phase(
+            "async", port, params, jobs, oracle, args, errors, lt=lt
+        )
+        repeat_zero, sub_zero = _async_probes(
+            engine, port, params, lt, jobs, oracle, args, errors
+        )
+    finally:
+        close_fn()
+        lt.stop()
+    if errors:
+        raise RuntimeError("open-loop leg failed: " + "; ".join(errors[:5]))
+    out = {
+        "metric": "loadgen_open_loop_completed_per_sec",
+        "value": round(stamp["completed"] / args.duration, 3),
+        "unit": "jobs/s",
+        "workload": args.wl_name,
+        "mode": "open-loop",
+        "ingress": "async",
+        "jobs": len(jobs),
+        "distinct_signatures": len(distinct),
+        "max_nonce": args.max_nonce,
+        "miners": args.miners,
+        "seed": args.seed,
+        "fast": bool(args.fast),
+        "open_loop": stamp,
+        "repeat_zero_chunks": repeat_zero,
+        "subrange_zero_chunks": sub_zero,
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _conn_scale_leg(kind: str, jobs, oracle, args) -> dict:
+    """One conn-scale leg: stand the stack up, ramp live conns (sampling
+    the process thread count mid-ramp and at full ramp), prove every conn
+    live with a bit-exact round trip, take open-loop load, then (async
+    leg) run the zero-chunk probes.  Returns the leg's stamp."""
+    import asyncio as _aio
+
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.apps import client as client_mod
+    from bitcoin_miner_tpu.utils.metrics import METRICS
+
+    errors: list = []
+    params, port, engine, close_fn = _serving_stack(kind, args)
+    lt = lsp.shared_loop("loadgen-aclients") if kind == "async" else None
+    target = (
+        args.conns if kind == "threaded" else args.conns * args.conn_multiple
+    )
+    conns: list = []
+
+    async def _connect_batch(n):
+        outs = await _aio.gather(
+            *(
+                lsp.AsyncClient.connect("127.0.0.1", port, params)
+                for _ in range(n)
+            ),
+            return_exceptions=True,
+        )
+        return [c for c in outs if not isinstance(c, BaseException)]
+
+    async def _verify_all(sig):
+        data, lo, hi = sig
+        out = []
+        for s in range(0, len(conns), 100):
+            out.extend(
+                await _aio.gather(
+                    *(
+                        _request_result(c, data, lo, hi, args.ol_timeout)
+                        for c in conns[s:s + 100]
+                    )
+                )
+            )
+        return out
+
+    async def _close_batch(batch):
+        await _aio.gather(
+            *( _aio.wait_for(c.close(), 2.0) for c in batch),
+            return_exceptions=True,
+        )
+
+    def ramp_to(n):
+        while len(conns) < n:
+            if kind == "threaded":
+                try:
+                    conns.append(lsp.Client("127.0.0.1", port, params))
+                except (lsp.LspError, OSError) as e:
+                    errors.append(f"conn ramp stalled at {len(conns)}: {e!r}")
+                    return
+            else:
+                batch = min(50, n - len(conns))
+                got = _aio.run_coroutine_threadsafe(
+                    _connect_batch(batch), lt.loop
+                ).result(timeout=60)
+                if not got:
+                    errors.append(f"conn ramp stalled at {len(conns)}")
+                    return
+                conns.extend(got)
+
+    try:
+        # Warm one signature so the liveness wave is pure cache hits.
+        warm = jobs[0]
+        wc = lsp.Client("127.0.0.1", port, params)
+        try:
+            got = client_mod.request_once(
+                wc, warm[0], warm[2], lower=warm[1], timeout=args.ol_timeout
+            )
+        finally:
+            wc.close()
+        if got != oracle[warm]:
+            errors.append(f"warm job wrong: got {got}, want {oracle[warm]}")
+        ramp_to(target // 2)
+        threads_half = threading.active_count()
+        ramp_to(target)
+        threads_full = threading.active_count()
+        rss_kb = _rss_kb()
+        # Liveness proof: every ramped conn completes a bit-exact round
+        # trip of the warmed (cached) signature — full duplex, zero
+        # device work, O(conns) only on the wire.
+        if kind == "threaded":
+            results = []
+            for c in conns:
+                try:
+                    results.append(
+                        client_mod.request_once(
+                            c, warm[0], warm[2], lower=warm[1],
+                            timeout=args.ol_timeout,
+                        )
+                    )
+                except (lsp.LspError, TimeoutError):
+                    results.append(None)
+        else:
+            results = _aio.run_coroutine_threadsafe(
+                _verify_all(warm), lt.loop
+            ).result(timeout=args.ol_timeout + 60)
+        live = sum(1 for g in results if g == oracle[warm])
+        # The gauge is published by the serve ticker (0.05 s cadence) —
+        # a loopback ramp can finish inside one tick, so give it a few
+        # beats before stamping the server-side corroboration.
+        time.sleep(0.25)
+        gauge_conns = METRICS.gauge("gw.conns_live")
+        open_loop = _open_loop_phase(
+            kind, port, params, jobs, oracle, args, errors, lt=lt
+        )
+        repeat_zero = sub_zero = None
+        if kind == "async":
+            repeat_zero, sub_zero = _async_probes(
+                engine, port, params, lt, jobs, oracle, args, errors
+            )
+    finally:
+        try:
+            if kind == "threaded":
+                for c in conns:
+                    try:
+                        c.close()
+                    except lsp.LspError:
+                        pass
+            elif conns:
+                for s in range(0, len(conns), 100):
+                    _aio.run_coroutine_threadsafe(
+                        _close_batch(conns[s:s + 100]), lt.loop
+                    ).result(timeout=30)
+        except Exception:
+            pass  # teardown best-effort: the server close below reaps conns
+        close_fn()
+        if lt is not None:
+            lt.stop()
+    if errors:
+        raise RuntimeError(f"conn-scale {kind} leg failed: " + "; ".join(errors[:5]))
+    stamp = {
+        "ingress": kind,
+        "conns_target": target,
+        "conns_live": live,
+        "gw_conns_live_gauge": gauge_conns,
+        "threads_at_half_ramp": threads_half,
+        "threads_at_full_ramp": threads_full,
+        "threads_flat": threads_full <= threads_half,
+        "rss_kb": rss_kb,
+        "open_loop": open_loop,
+    }
+    if kind == "async":
+        stamp["repeat_zero_chunks"] = repeat_zero
+        stamp["subrange_zero_chunks"] = sub_zero
+    return stamp
+
+
+def _conn_scale_main(jobs, distinct, args, oracle) -> int:
+    """The --conn-scale bench pair (BENCH_pr15.json): threaded facade vs
+    async ingress on the same seeded workload."""
+    thr = _conn_scale_leg("threaded", jobs, oracle, args)
+    log(f"threaded leg: {thr}")
+    asy = _conn_scale_leg("async", jobs, oracle, args)
+    log(f"async leg: {asy}")
+    multiple = (
+        round(asy["conns_live"] / thr["conns_live"], 2)
+        if thr["conns_live"] else None
+    )
+    out = {
+        "metric": "conn_scale_async_conn_multiple",
+        "value": multiple,
+        "unit": "x live conns vs threaded facade",
+        "workload": args.wl_name,
+        "mode": "conn-scale",
+        "jobs": len(jobs),
+        "distinct_signatures": len(distinct),
+        "max_nonce": args.max_nonce,
+        "miners": args.miners,
+        "seed": args.seed,
+        "fast": bool(args.fast),
+        "open_loop_rate": args.open_loop,
+        "threaded": thr,
+        "async": asy,
+        "repeat_zero_chunks": asy.get("repeat_zero_chunks"),
+        "subrange_zero_chunks": asy.get("subrange_zero_chunks"),
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--clients", type=int, default=8)
@@ -592,6 +1174,32 @@ def main(argv=None) -> int:
                     help="registered range-fold workload to serve/bench "
                          "(ISSUE 9; default: the frozen sha256d contract; "
                          "env BMT_WORKLOAD)")
+    ap.add_argument("--open-loop", type=float, default=None, metavar="RATE",
+                    help="open-loop bench (ISSUE 15): Poisson arrivals at "
+                         "RATE req/s for --duration seconds against the "
+                         "async event-loop ingress — shed rate and p99 "
+                         "under saturation, the way production traffic "
+                         "actually arrives")
+    ap.add_argument("--duration", type=float, default=8.0,
+                    help="open-loop measurement window (seconds)")
+    ap.add_argument("--conn-scale", action="store_true",
+                    help="ingress bench pair (ISSUE 15): threaded-facade "
+                         "leg vs async-ingress leg — live conns, thread "
+                         "counts, RSS, open-loop shed/p99 per leg "
+                         "(BENCH_pr15.json)")
+    ap.add_argument("--conns", type=int, default=50,
+                    help="threaded-facade leg live-conn ramp target "
+                         "(the async leg ramps --conn-multiple x this)")
+    ap.add_argument("--conn-multiple", type=int, default=10,
+                    help="async leg conn multiple over the threaded leg")
+    ap.add_argument("--ol-queue", type=int, default=32,
+                    help="gateway max_queued for the ingress benches (the "
+                         "overload lever: beyond it requests shed)")
+    ap.add_argument("--ol-timeout", type=float, default=20.0,
+                    help="per-request deadline in the ingress benches")
+    ap.add_argument("--ol-max-threads", type=int, default=64,
+                    help="threaded-leg open-loop request-thread cap "
+                         "(arrivals past it are turned away client-side)")
     ap.add_argument("--fast", action="store_true",
                     help="tier-1 preset: small jobs, done in well under 30 s")
     args = ap.parse_args(argv)
@@ -606,10 +1214,22 @@ def main(argv=None) -> int:
         ap.error("an overhead measurement cannot run with the other "
                  "plane armed (--trace/--trace-overhead vs --telemetry/"
                  "--telemetry-overhead): measure one plane at a time")
+    if args.conn_scale and args.open_loop is None:
+        args.open_loop = 40.0  # the pair always takes open-loop load
+    if args.open_loop is not None and args.open_loop <= 0:
+        ap.error("--open-loop RATE must be > 0 (Poisson arrivals/sec)")
+    if args.open_loop is not None and (args.federation or args.overlap):
+        ap.error("--open-loop/--conn-scale are their own modes — run them "
+                 "without --federation/--overlap")
     if args.fast:
         args.jobs = min(args.jobs, 24)
         args.max_nonce = min(args.max_nonce, 4000)
         args.timeout = min(args.timeout, 60.0)
+        args.duration = min(args.duration, 3.0)
+        if args.open_loop is not None:
+            args.open_loop = min(args.open_loop, 40.0)
+        args.conns = min(args.conns, 20)
+        args.ol_timeout = min(args.ol_timeout, 15.0)
 
     import os
 
@@ -651,6 +1271,10 @@ def main(argv=None) -> int:
         return _federation_main(jobs, distinct, args, oracle)
     if args.overlap:
         return _overlap_main(jobs, distinct, args, oracle)
+    if args.conn_scale:
+        return _conn_scale_main(jobs, distinct, args, oracle)
+    if args.open_loop is not None:
+        return _open_loop_main(jobs, distinct, args, oracle)
 
     import tempfile
     from contextlib import ExitStack
