@@ -325,7 +325,7 @@ def run_sieve_compare(args, watchdog) -> int:
     watchdog.disarm()
     r_base = n / dt_base
     r_sieve = n / dt_sieve
-    _, _, _, tuned_sieve, _ = auto_tune(backend, None, None)
+    _, _, _, tuned_sieve, _, _ = auto_tune(backend, None, None)
     log(
         f"swept {n} nonces twice: baseline {r_base:,.0f} n/s, sieve "
         f"{r_sieve:,.0f} n/s (ratio {r_sieve / r_base:.3f}); auto_tune "
@@ -467,7 +467,7 @@ def run_factor_compare(args, watchdog) -> int:
     watchdog.disarm()
     r_base = n / dt_base
     r_fact = n / dt_fact
-    _, _, _, _, tuned_factored = auto_tune(backend, None, None)
+    _, _, _, _, tuned_factored, _ = auto_tune(backend, None, None)
     log(
         f"swept {n} nonces twice: baseline {r_base:,.0f} n/s, factored "
         f"{r_fact:,.0f} n/s (ratio {r_fact / r_base:.3f}); auto_tune "
@@ -491,6 +491,146 @@ def run_factor_compare(args, watchdog) -> int:
     }
     if interp_ok is not None:
         out["interpret_pallas_factored_bitexact"] = bool(interp_ok)
+    emit(out)
+    return 0
+
+
+def run_hot_compare(args, watchdog) -> int:
+    """--hot-compare: same-seed persistent-vs-per-chunk dispatch legs
+    (ISSUE 16).
+
+    Runs the SAME data + nonce range through the per-chunk dispatch path
+    and the always-hot plane (donated running-min carry + device
+    descriptor ring) of the resolved jax tier — both legs at the
+    backend's default sieve/factored rungs, so the pair isolates the
+    dispatch discipline — and emits one JSON line with both rates (the
+    BENCH_pr16 artifact).  Both legs are bit-exactness-gated against the
+    hashlib oracle first on a digit-boundary-crossing range; ``--fast``
+    swaps the timed windows for tiny tier-1-sized ones and adds
+    interpret-mode pallas hot gates (plain AND composed with the sieve's
+    device-carried threshold), so the correctness half runs on every PR.
+
+    Honesty contract: ``auto_tune_hot`` records which dispatch
+    discipline :func:`bitcoin_miner_tpu.ops.sweep.auto_tune` actually
+    picks for this backend — if the hot leg loses here, the default
+    demonstrably keeps the per-chunk path and both numbers still land.
+    """
+    import jax
+
+    from bitcoin_miner_tpu.bitcoin.hash import min_hash_range
+    from bitcoin_miner_tpu.ops.sweep import auto_tune, sweep_min_hash
+    from bitcoin_miner_tpu.utils.platform import enable_compile_cache, is_tpu
+
+    enable_compile_cache()
+    for flag, val in (("--autotune", args.autotune), ("--profile", args.profile)):
+        if val:
+            log(f"WARNING: {flag} is ignored in --hot-compare mode")
+    watchdog.beat("device init (jax.devices)")
+    dev = jax.devices()[0]
+    platform = dev.platform
+    if args.backend in ("pallas", "xla"):
+        backend = args.backend
+    elif args.backend == "native":
+        emit({"error": "--hot-compare applies to the jax tiers only"})
+        return 1
+    else:
+        backend = "pallas" if is_tpu() else "xla"
+    data = "cmu440"  # the flagship BASELINE shape
+
+    # -- correctness gates: both disciplines, digit-boundary range -----------
+    lo, hi = 95, 1205
+    expect = min_hash_range(data, lo, hi)
+    watchdog.beat("hot-compare correctness gates (first compiles)")
+    for hot in (False, True):
+        r = sweep_min_hash(data, lo, hi, backend=backend, max_k=2, hot=hot)
+        if (r.hash, r.nonce) != expect:
+            emit(
+                {
+                    "error": "hot-compare correctness gate failed",
+                    "hot": hot,
+                    "kernel": [r.hash, r.nonce],
+                    "oracle": list(expect),
+                    "backend": backend,
+                }
+            )
+            return 1
+    interp_ok = None
+    if args.fast:
+        # Tier-1 also covers the REAL prize path in interpreter mode: the
+        # pallas hot plane (donated carry threaded through the flipped
+        # scalar-prefetch threshold) bit-exact across a digit boundary —
+        # plain and composed with the PR-13 sieve, whose threshold is now
+        # the device-carried running min.
+        watchdog.beat("interpret-mode pallas hot gates")
+        expect_i = min_hash_range(data, 985, 1040)
+        interp_ok = True
+        for sieve in (False, True):
+            ri = sweep_min_hash(
+                data, 985, 1040, backend="pallas", interpret=True,
+                batch=2, max_k=2, hot=True, sieve=sieve,
+            )
+            interp_ok = interp_ok and (ri.hash, ri.nonce) == expect_i
+        if not interp_ok:
+            emit({"error": "interpret-mode pallas hot gate failed"})
+            return 1
+    log("correctness OK: per-chunk and hot dispatch match the oracle")
+
+    # -- same-seed timed legs ------------------------------------------------
+    base = 10**9
+
+    def timed(n: int, hot: bool) -> float:
+        watchdog.beat(
+            f"timed {'hot' if hot else 'per-chunk'} sweep of {n}"
+        )
+        t0 = time.perf_counter()
+        r = sweep_min_hash(data, base, base + n - 1, backend=backend, hot=hot)
+        dt = time.perf_counter() - t0
+        assert r.lanes_swept == n
+        watchdog.beat()
+        return dt
+
+    warm = 10**5 if args.fast else 10**6
+    timed(warm, False)  # compile both dispatch disciplines
+    timed(warm, True)
+    if args.fast:
+        n = 2 * 10**5
+    else:
+        n = 4 * 10**6
+        dt = timed(n, False)
+        while dt < 4.0 and n < 16 * 10**9:
+            n = min(n * max(2, int(4.0 / max(dt, 1e-3))), 16 * 10**9)
+            dt = timed(n, False)
+    # Interleaved best-of-2 per leg: same-seed PAIR, not single numbers
+    # (this box's wall clock swings run-to-run — ROADMAP).
+    dt_chunk = min(timed(n, False), timed(n, False))
+    dt_hot = min(timed(n, True), timed(n, True))
+    watchdog.disarm()
+    r_chunk = n / dt_chunk
+    r_hot = n / dt_hot
+    _, _, _, _, _, tuned_hot = auto_tune(backend, None, None)
+    log(
+        f"swept {n} nonces twice: per-chunk {r_chunk:,.0f} n/s, hot "
+        f"{r_hot:,.0f} n/s (ratio {r_hot / r_chunk:.3f}); auto_tune "
+        f"keeps the {'hot' if tuned_hot else 'per-chunk'} dispatch "
+        f"for backend={backend}"
+    )
+    out = {
+        "metric": "hot_compare",
+        "unit": "nonces/s",
+        "data": data,
+        "count": n,
+        "perchunk_nps": round(r_chunk),
+        "hot_nps": round(r_hot),
+        "ratio": round(r_hot / r_chunk, 4),
+        "auto_tune_hot": bool(tuned_hot),
+        "kept_kernel": "hot" if tuned_hot else "per-chunk",
+        "platform": platform,
+        "backend": backend,
+        "bitexact": True,
+        "fast": bool(args.fast),
+    }
+    if interp_ok is not None:
+        out["interpret_pallas_hot_bitexact"] = bool(interp_ok)
     emit(out)
     return 0
 
@@ -536,10 +676,18 @@ def main() -> int:
         "jax tier (ISSUE 14); emits the BENCH_pr14 factor_compare JSON line",
     )
     ap.add_argument(
+        "--hot-compare",
+        action="store_true",
+        help="same-seed persistent-vs-per-chunk dispatch legs on the "
+        "resolved jax tier (ISSUE 16); emits the BENCH_pr16 hot_compare "
+        "JSON line",
+    )
+    ap.add_argument(
         "--fast",
         action="store_true",
-        help="with --sieve-compare / --factor-compare: tiny tier-1-sized "
-        "timed windows plus interpret-mode pallas correctness legs",
+        help="with --sieve-compare / --factor-compare / --hot-compare: "
+        "tiny tier-1-sized timed windows plus interpret-mode pallas "
+        "correctness legs",
     )
     ap.add_argument(
         "--devices",
@@ -577,6 +725,7 @@ def main() -> int:
             ("--profile", args.profile),
             ("--sieve-compare", args.sieve_compare),
             ("--factor-compare", args.factor_compare),
+            ("--hot-compare", args.hot_compare),
             ("--fast", args.fast),
         ):
             if val:
@@ -617,17 +766,24 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
     enable_compile_cache()
 
-    if args.sieve_compare and args.factor_compare:
-        emit({"error": "--sieve-compare and --factor-compare are exclusive"})
+    if sum((args.sieve_compare, args.factor_compare, args.hot_compare)) > 1:
+        emit(
+            {
+                "error": "--sieve-compare, --factor-compare and "
+                "--hot-compare are exclusive"
+            }
+        )
         return 1
     if args.sieve_compare:
         return run_sieve_compare(args, watchdog)
     if args.factor_compare:
         return run_factor_compare(args, watchdog)
+    if args.hot_compare:
+        return run_hot_compare(args, watchdog)
     if args.fast:
         log(
             "WARNING: --fast only applies to --sieve-compare/"
-            "--factor-compare; ignored"
+            "--factor-compare/--hot-compare; ignored"
         )
 
     from bitcoin_miner_tpu import native

@@ -494,9 +494,10 @@ def auto_tune(
     max_k: Optional[int],
     sieve: Optional[bool] = None,
     factored: Optional[bool] = None,
-) -> Tuple[str, int, int, bool, bool]:
-    """Resolve the (backend, rows-per-dispatch, max_k, sieve, factored)
-    defaults shared by the single-device and sharded sweep drivers.
+    hot: Optional[bool] = None,
+) -> Tuple[str, int, int, bool, bool, bool]:
+    """Resolve the (backend, rows-per-dispatch, max_k, sieve, factored,
+    hot) defaults shared by the single-device and sharded sweep drivers.
     max_k=5 bounds the xla tier's compress_rolled schedule buffer
     ((16, B, 10^k) u32) to ~50 MB at B=8.
 
@@ -533,7 +534,25 @@ def auto_tune(
     programs ~4× (1024-lane inner tiles vs 4096), neither of which this
     host can price; ``bench.py --factor-compare`` on real TPU is the
     arbiter (ROADMAP follow-on), and a shape where factoring loses keeps
-    the current kernel by default."""
+    the current kernel by default.
+
+    The **hot rung** (ISSUE 16, ``hot=None`` = auto): the always-hot
+    device plane (donated carried best/threshold buffers + the async
+    chunk-descriptor ring, :class:`_HotLoop`) wraps whichever kernel
+    variant the other rungs resolved.  OFF by default on BOTH tiers on
+    this host: the same-seed pair (``bench.py --hot-compare``,
+    BENCH_pr16.json) measured the donated/ring path at parity with the
+    per-chunk path on XLA:CPU (ratio 1.02: hot 2.31M vs per-chunk 2.26M
+    n/s, inside this host's run-to-run swing and under the 1.15×
+    promotion bar) — per-dispatch cost here is kernel compute
+    (~0.16 s at batch 4), so eliding the output allocation and the
+    host-side fold is below noise — and the rung's real target, the
+    tunnelled TPU's O(100 ms) dispatch+fetch latency and the per-dispatch
+    host sync the per-chunk fold forces, cannot be priced off-TPU
+    (real-TPU arbitration is the ROADMAP follow-on, same pattern as the
+    factored pallas rung).  A shape where the hot plane does not
+    demonstrably win keeps the per-chunk kernel by default; the plane
+    stays available behind ``hot=True`` and is bit-exact either way."""
     if backend is None:
         backend = _default_backend()
     if batch is None:
@@ -555,7 +574,9 @@ def auto_tune(
         sieve = backend == "pallas"
     if factored is None:
         factored = backend == "xla"
-    return backend, batch, max_k, sieve, factored
+    if hot is None:
+        hot = False
+    return backend, batch, max_k, sieve, factored, hot
 
 
 @dataclass(frozen=True)
@@ -811,6 +832,278 @@ def _invoke_kernel(backend, kern, midstate, tail_const, bounds, thresh=None):
     )
 
 
+# --------------------------------------------------------------------------
+# Always-hot device plane (ISSUE 16)
+# --------------------------------------------------------------------------
+
+
+def _flip_thresh_traced(th):
+    """A TRACED uint32 threshold -> the pallas sieve kernel's pre-sign-
+    flipped ``(1,)`` int32 operand (its comparisons live in that domain).
+    The per-chunk path does this flip on the host (:func:`_invoke_kernel`);
+    the hot step must do it on device because the threshold is the carried
+    ``best_h0`` and never visits the host."""
+    return jax.lax.bitcast_convert_type(
+        th ^ jnp.uint32(0x80000000), jnp.int32
+    ).reshape(1)
+
+
+def make_hot_step(backend, kern, sieve, mesh=False):
+    """Build the donated-buffer dispatch step wrapping one sweep kernel.
+
+    Carried-state contract (the hot plane's analogue of ops/sha256.py's
+    midstate contract):
+
+    - The carry is ``(best_h0, best_h1, best_seq, [best_dev,] best_flat)``
+      — u32/u32/i32/[i32/]i32 scalars.  ``best_flat == I32_MAX`` marks a
+      vacant carry; ``best_seq`` is the dispatch sequence number whose
+      ``(bases, 10^k)`` descriptor resolves the winning flat lane to a
+      nonce on the host (``best_dev`` additionally scales the row in mesh
+      mode, exactly like the per-chunk sharded fold).
+    - The carry is **donated** (``donate_argnums=(0,)``): XLA aliases the
+      input buffers into the output, so a steady-state dispatch allocates
+      no fresh device memory for the accumulator and the caller's old
+      carry handle is dead the moment the step is enqueued.
+    - ``carry[0]`` IS the sieve threshold.  It always equals the min h0
+      seen over dispatches ``< seq``, and the kernels' pass-1 predicate is
+      ``h0 <= thresh``, so an exact tie still survives to pass 2 — the
+      same conservative contract as the operand-shipped threshold, but
+      with zero staleness: dispatch N+1 reads the min through dispatch N
+      regardless of how deep the pipeline runs.
+    - Ties across dispatches keep the CARRIED candidate.  Dispatches are
+      enqueued in ascending nonce order (:func:`decompose_range`), so the
+      carried winner of an exact ``(h0, h1)`` tie is the lower nonce, and
+      within a dispatch the kernel already resolves ties to the lowest
+      flat lane.
+    - Each step also returns a tiny PROBE copy ``[best_h0, best_seq]``
+      (a fresh ``(2,)`` buffer, never aliased to the donated carry): the
+      host blocks on probes — not the carry — for backpressure, the
+      per-dispatch latency histogram, and pruning the seq->descriptor
+      map; the carry itself is only fetched once, at job end.  This is a
+      hard rule, not a style choice: materialising a carry element
+      host-side pins its buffer (jax caches the host view), and the next
+      step's donation silently falls back to a fresh-buffer copy.
+    """
+    sentinel = jnp.int32(I32_MAX)
+
+    def _merge(carry, seq, h0, h1, extra):
+        # extra = (flat,) single-device, (dev, flat) mesh.
+        bh0, bh1, bseq = carry[0], carry[1], carry[2]
+        bflat = carry[-1]
+        flat = extra[-1]
+        valid = flat != sentinel
+        vacant = bflat == sentinel
+        # Strict compare + vacant clause: an exact (h0, h1) tie keeps the
+        # carried (earlier-dispatch -> lower-nonce) candidate; the vacant
+        # clause admits a first candidate even at h0 == U32_MAX.
+        better = valid & (vacant | (h0 < bh0) | ((h0 == bh0) & (h1 < bh1)))
+        new_vals = (h0, h1, seq) + extra
+        new = tuple(
+            jnp.where(better, n, b) for n, b in zip(new_vals, carry)
+        )
+        probe = jnp.stack([new[0], new[2].astype(jnp.uint32)])
+        return new, probe
+
+    if backend == "pallas" and not mesh:
+        def step(carry, seq, midstate, tailcb):
+            th = (_flip_thresh_traced(carry[0]),) if sieve else ()
+            h0, h1, flat = kern(midstate, tailcb, *th)
+            return _merge(carry, seq, h0, h1, (flat,))
+    elif mesh:
+        def step(carry, seq, midstate, tail_const, bounds):
+            th = (carry[0],) if sieve else ()
+            h0, h1, dev, flat = kern(midstate, tail_const, bounds, *th)
+            return _merge(carry, seq, h0, h1, (dev, flat))
+    else:
+        def step(carry, seq, midstate, tail_const, bounds):
+            th = (carry[0],) if sieve else ()
+            h0, h1, flat = kern(midstate, tail_const, bounds, *th)
+            return _merge(carry, seq, h0, h1, (flat,))
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+#: Hot steps are cached per wrapped kernel OBJECT (not per class_key: the
+#: dyn pallas wrapper closes over per-class contribution tiles, so two
+#: classes sharing one executable still need distinct steps).  Kernel
+#: objects are themselves lru_cached, so this stays bounded by the same
+#: cache budget.
+_HOT_STEPS: dict = {}
+
+
+def _hot_step_for(backend, kern, sieve, mesh):
+    key = (kern, backend, bool(sieve), mesh is not None)
+    step = _HOT_STEPS.get(key)
+    if step is None:
+        step = _HOT_STEPS[key] = make_hot_step(
+            backend, kern, sieve, mesh=mesh is not None
+        )
+    return step
+
+
+@dataclass(frozen=True)
+class _HotToken:
+    """One hot dispatch's handle through a driver's ``consume``: the
+    sequence number, the probe array to block on, and the enqueue stamp."""
+
+    seq: int
+    probe: object
+    t_enq: float
+
+
+class _HotLoop:
+    """Job-lifetime always-hot dispatch plane (ISSUE 16).
+
+    One instance per job.  The host refills a small descriptor ring —
+    asynchronous device transfers of each dispatch's ``(midstate row,
+    tail templates, bounds)`` — ahead of the device consuming them, and
+    every dispatch is one donated step (:func:`make_hot_step`) carrying
+    the ``(best, threshold)`` state in place on device.  The per-chunk
+    drivers' backpressure (``max_inflight`` / the fetch queue) bounds the
+    live ring window; :data:`_RING_DEPTH` bounds the refill lookahead the
+    host keeps strong references to.
+
+    Zero-staleness sieving falls out of the carry: ``carry[0]`` is the
+    running-min h0 through the previous dispatch, so the threshold a
+    dispatch sieves against lags by exactly one dispatch (the per-chunk
+    operand-shipped threshold lags by the whole in-flight window) —
+    ``kernel.thresh_staleness`` records the contrast.
+    """
+
+    _RING_DEPTH = 8
+
+    def __init__(
+        self, backend, sieve, *, mesh=None, axis_name="miners",
+        per_dev_batch=0,
+    ):
+        self._backend = backend
+        self._sieve = sieve
+        self._mesh = mesh
+        self._axis_name = axis_name
+        self._per_dev_batch = per_dev_batch
+        self._carry = None
+        self._seq = 0
+        self._drained = 0
+        #: seq -> (bases, 10^k): resolves the carried winner's flat lane
+        #: to a nonce at job end; pruned by probe drains to O(in-flight).
+        self._bases: dict = {}
+        #: The refill lookahead: strong refs to the last few descriptor
+        #: slots shipped to the device (the transfers themselves are
+        #: async; execution keeps them alive once enqueued).
+        self._ring: collections.deque = collections.deque(
+            maxlen=self._RING_DEPTH
+        )
+
+    @property
+    def carry(self):
+        return self._carry
+
+    def _fresh_carry(self):
+        vals = (
+            np.uint32(U32_MAX), np.uint32(U32_MAX), np.int32(-1),
+        ) + ((np.int32(0),) if self._mesh is not None else ()) + (
+            np.int32(I32_MAX),
+        )
+        if self._mesh is None:
+            return tuple(jnp.asarray(v) for v in vals)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(self._mesh, PartitionSpec())
+        return tuple(jax.device_put(v, rep) for v in vals)
+
+    def _refill(self, midstate, tail_const, bounds):
+        """Ship one chunk descriptor to the device, asynchronously: the
+        ring-slot transfer starts now and overlaps the dispatches already
+        in the device queue."""
+        if self._mesh is not None:
+            from ..parallel.sweep import shard_operands
+
+            slot = shard_operands(
+                midstate, tail_const, bounds, self._mesh, self._axis_name
+            )
+        elif self._backend == "pallas":
+            tailcb = np.concatenate(
+                [tail_const, bounds.astype(np.uint32)], axis=1
+            )
+            slot = (jnp.asarray(midstate), jnp.asarray(tailcb))
+        else:
+            slot = (
+                jnp.asarray(midstate),
+                jnp.asarray(tail_const),
+                jnp.asarray(bounds),
+            )
+        self._ring.append(slot)
+        METRICS.inc("sweep.ring_refills")
+        return slot
+
+    def dispatch(self, kern, midstate, tail_const, bounds) -> _HotToken:
+        """Enqueue one donated step; returns the token ``consume`` later
+        drains.  Called from the (single) dispatcher thread only — the
+        carry handle swap is not locked."""
+        step = _hot_step_for(self._backend, kern, self._sieve, self._mesh)
+        if self._carry is None:
+            self._carry = self._fresh_carry()
+        slot = self._refill(midstate, tail_const, bounds)
+        seq = self._seq
+        self._seq = seq + 1
+        self._carry, probe = step(self._carry, jnp.int32(seq), *slot)
+        METRICS.inc("sweep.donated_dispatches")
+        if self._sieve:
+            # By construction: the threshold this step sieved against is
+            # the running min through dispatch seq-1.
+            METRICS.set_gauge("kernel.thresh_staleness", 1.0)
+        return _HotToken(seq=seq, probe=probe, t_enq=_time.monotonic())
+
+    def drain(self, token: _HotToken, bases, n_lanes) -> float:
+        """Block on one dispatch's probe: registers its descriptor,
+        prunes every descriptor the carry can no longer reference, and
+        reports the per-dispatch latency.  Tokens drain in FIFO dispatch
+        order (both drivers guarantee it)."""
+        self._bases[token.seq] = (bases, n_lanes)
+        vals = np.asarray(token.probe)  # blocks until the step lands
+        self._drained += 1
+        best_seq = int(vals[1])
+        # The final winner is either this probe's best_seq or a dispatch
+        # AFTER token.seq (the carry only moves to strictly better, later
+        # candidates) — every other descriptor at or below token.seq is
+        # dead.  Keeps host state O(in-flight) over 10^6-dispatch jobs.
+        for s in [s for s in self._bases if s <= token.seq and s != best_seq]:
+            del self._bases[s]
+        dt = _time.monotonic() - token.t_enq
+        METRICS.observe("hist.device_dispatch_s", dt)
+        if _trace.enabled():
+            _trace.emit(
+                None, "kernel", "dispatch_done",
+                rows=len(bases), lanes=n_lanes, dt=round(dt, 6),
+                ring=self._seq - self._drained, donated=True,
+            )
+        return dt
+
+    def finish(self):
+        """Fetch the carry ONCE (the only full sync of the job) and
+        resolve it to a ``(hash, nonce)`` candidate, or None if no device
+        dispatch produced a valid lane."""
+        if self._carry is None:
+            return None
+        if self._mesh is not None:
+            bh0, bh1, bseq, bdev, bflat = (int(x) for x in self._carry)
+        else:
+            bh0, bh1, bseq, bflat = (int(x) for x in self._carry)
+            bdev = 0
+        if bflat == I32_MAX:
+            return None
+        entry = self._bases.get(bseq)
+        if entry is None:
+            # Only reachable when a fetch was dropped (injected wedge /
+            # close mid-job): the winning dispatch's descriptor is gone.
+            raise RuntimeError(
+                "hot sweep winner's descriptor was never drained"
+            )
+        bases, n_lanes = entry
+        row = bdev * self._per_dev_batch + bflat // n_lanes
+        return ((bh0 << 32) | bh1, bases[row] + bflat % n_lanes)
+
+
 #: TPU-runtime fault injection (ISSUE 10 satellite, carry-over from PR 2):
 #: ``BMT_WEDGE_DISPATCH=N`` makes the N-th result fetched by the FIRST
 #: armed pipeline in this process hang until that pipeline is closed —
@@ -860,6 +1153,7 @@ class SweepPipeline:
         workload=None,
         sieve: Optional[bool] = None,
         factored: Optional[bool] = None,
+        hot: Optional[bool] = None,
     ) -> None:
         import queue as _queue
         import threading
@@ -880,18 +1174,18 @@ class SweepPipeline:
                 backend = "xla"
         (
             self._backend, self._batch, self._max_k, self._sieve,
-            self._factored,
-        ) = auto_tune(backend, batch, max_k, sieve, factored)
-        if mesh is not None:
+            self._factored, self._hot,
+        ) = auto_tune(backend, batch, max_k, sieve, factored, hot)
+        if mesh is not None and self._backend == "pallas":
             # The sharded tier runs the PER-SHARD sieve (ISSUE 14
-            # satellite): each shard seeds pass 1 from the dispatch
-            # threshold and tightens its own local running-min in SMEM
-            # scratch ahead of the collective argmin cascade — a shard
-            # with no survivor contributes the sentinel, which the pmin
-            # cascade orders after any real survivor.  Factoring stays
-            # off in mesh mode for now (the sharded kernels keep the
-            # baseline/dyn forms; a factored sharded tier is a ROADMAP
-            # follow-on).
+            # satellite) on both backends, and — since ISSUE 16 — the
+            # FACTORED kernels on the xla backend too (the outer/inner
+            # split threads through _make_sharded_kernel, so a mesh
+            # miner gets the 2.76× xla win).  Factoring stays off for
+            # sharded *pallas* only: that tier keeps the dyn kernels
+            # (the factored pallas kernel is per-class static, and its
+            # cost can only be priced on real TPU — same arbitration
+            # follow-on as the single-device pallas rung).
             self._factored = False
         self._tile = tile
         self._cpb = cpb
@@ -1058,6 +1352,7 @@ class SweepPipeline:
                 self._interpret,
                 self._rolled,
                 sieve=self._sieve,
+                factored=self._factored,
             )
         return _build_kernel(
             self._backend,
@@ -1102,6 +1397,16 @@ class SweepPipeline:
                 return
             data, lower, upper, fut = item
             state = {"best": [], "lanes": 0, "fut": fut}
+            if self._hot:
+                # One hot loop per job: the donated carry is the job's
+                # running (best, threshold) state; its tokens flow through
+                # the same fetch queue as per-chunk handles, so the wedge
+                # drill and the backpressure window are unchanged.
+                state["hot"] = _HotLoop(
+                    self._backend, self._sieve, mesh=self._mesh,
+                    axis_name=self._axis_name,
+                    per_dev_batch=self._per_dev_batch,
+                )
 
             def run_kernel(kern, midstate, tail_const, bounds):
                 # Class lock: a cold class traces inside this call; holding
@@ -1110,6 +1415,12 @@ class SweepPipeline:
                 # lock is uncontended in steady state.  The enqueue stamp
                 # rides with the handle so the fetcher can report each
                 # dispatch's enqueue→fetch time (hist.device_dispatch_s).
+                hot = state.get("hot")
+                if hot is not None:
+                    with self._class_lock(kern):
+                        tok = hot.dispatch(kern, midstate, tail_const, bounds)
+                        self._warm_keys.add(getattr(kern, "class_key", kern))
+                        return tok
                 th = None
                 if self._sieve:
                     # Sieve threshold: the running-min h0 known at ENQUEUE
@@ -1117,6 +1428,13 @@ class SweepPipeline:
                     # looser — read is conservative-correct, so no lock).
                     b = state["best"]
                     th = (b[0][0] >> 32) if b else U32_MAX
+                    # The contrast number for the hot plane's zero-lag
+                    # carry: an operand-shipped threshold is as stale as
+                    # the whole in-flight window.
+                    METRICS.set_gauge(
+                        "kernel.thresh_staleness",
+                        float(self._fetches.qsize() + 1),
+                    )
                 with self._class_lock(kern):
                     out = self._invoke(
                         kern, midstate, tail_const, bounds, thresh=th
@@ -1172,6 +1490,15 @@ class SweepPipeline:
             if out is self._DONE:
                 if not fut.done():  # not already failed by the dispatcher
                     best = state["best"]
+                    hot = state.get("hot")
+                    if hot is not None:
+                        try:
+                            cand = hot.finish()
+                        except BaseException as e:
+                            self._fail(fut, e)
+                            continue
+                        if cand is not None and (not best or cand < best[0]):
+                            best[:] = [cand]
                     if not best:
                         self._fail(
                             fut, RuntimeError("sweep produced no candidates")
@@ -1193,6 +1520,12 @@ class SweepPipeline:
                 if not best or cand < best[0]:
                     best[:] = [cand]
                 continue
+            if isinstance(out, _HotToken):
+                try:
+                    state["hot"].drain(out, bases, n_lanes)
+                except BaseException as e:
+                    self._fail(fut, e)
+                continue
             try:
                 handles, t_enq = out  # run_kernel stamped the enqueue
                 if len(handles) == 4:  # mesh mode: (h0, h1, device, flat)
@@ -1210,9 +1543,14 @@ class SweepPipeline:
                 dt = _time.monotonic() - t_enq
                 METRICS.observe("hist.device_dispatch_s", dt)
                 if _trace.enabled():
+                    # ring/donated attrs (ISSUE 16): the per-chunk path
+                    # allocates fresh buffers per dispatch and has no
+                    # descriptor ring — the hot plane's emits say the
+                    # opposite (_HotLoop.drain).
                     _trace.emit(
                         None, "kernel", "dispatch_done",
                         rows=len(bases), lanes=n_lanes, dt=round(dt, 6),
+                        ring=0, donated=False,
                     )
                 if fi != I32_MAX:
                     h = (int(h0) << 32) | int(h1)
@@ -1239,6 +1577,7 @@ def sweep_min_hash(
     workload=None,
     sieve: Optional[bool] = None,
     factored: Optional[bool] = None,
+    hot: Optional[bool] = None,
 ) -> SweepResult:
     """Find ``(min Hash(data, n), argmin n)`` over inclusive ``[lower,
     upper]`` on the default JAX device.  Bit-exact vs the hashlib oracle
@@ -1265,14 +1604,20 @@ def sweep_min_hash(
     = the :func:`auto_tune` rung): the lane axis splits into outer digit
     groups whose invariant round prefix is computed once per group on
     the scalar unit — composable with ``sieve``, bit-exact either way.
+    ``hot`` = the always-hot device plane (ISSUE 16; None = the
+    :func:`auto_tune` rung): dispatches become donated steps over a
+    device-carried ``(best, threshold)`` buffer fed by an async chunk-
+    descriptor ring (:class:`_HotLoop`) — composable with both other
+    rungs, bit-exact either way.
     """
-    backend, batch, max_k, sieve, factored = auto_tune(
-        backend, batch, max_k, sieve, factored
+    backend, batch, max_k, sieve, factored, hot = auto_tune(
+        backend, batch, max_k, sieve, factored, hot
     )
     rolled = not is_tpu()
     sep, host_min, _native_ok = _workload_knobs(workload)
 
     best: List[Tuple[int, int]] = []  # [(hash, nonce)] — current minimum
+    hotloop = _HotLoop(backend, sieve) if hot else None
 
     def get_kernel(layout, group):
         return _build_kernel(
@@ -1281,6 +1626,8 @@ def sweep_min_hash(
         )
 
     def run_kernel(kern, midstate, tail_const, bounds):
+        if hotloop is not None:
+            return hotloop.dispatch(kern, midstate, tail_const, bounds)
         th = None
         if sieve:
             # The running-min h0 at enqueue time; pipelined dispatches may
@@ -1295,6 +1642,9 @@ def sweep_min_hash(
             cand = (out.hash, out.nonce)
             if not best or cand < best[0]:
                 best[:] = [cand]
+            return
+        if isinstance(out, _HotToken):
+            hotloop.drain(out, bases, n_lanes)
             return
         h0, h1, flat_idx = out
         fi = int(flat_idx)
@@ -1311,6 +1661,12 @@ def sweep_min_hash(
         data, lower, upper, max_k, batch, get_kernel, run_kernel, consume,
         host_lane_budget=host_lane_budget, sep=sep, host_min=host_min,
     )
+    if hotloop is not None:
+        # The job's ONE carry fetch: every device dispatch folded on
+        # device; merge with any host-routed candidates.
+        cand = hotloop.finish()
+        if cand is not None and (not best or cand < best[0]):
+            best[:] = [cand]
     if not best:
         raise RuntimeError("sweep produced no candidates")
     return SweepResult(hash=best[0][0], nonce=best[0][1], lanes_swept=lanes)

@@ -31,6 +31,7 @@ from ..ops.sweep import (
     SweepResult,
     _workload_knobs,
     auto_tune,
+    default_factor_k_in,
     make_kernel_body,
     run_sweep_dispatches,
 )
@@ -99,6 +100,7 @@ def _make_sharded_kernel(
     interpret: bool,
     rolled: bool,
     sieve: bool = False,
+    factored: int = 0,
 ):
     """Compile the sharded kernel for one (layout, k, batch) shape class
     (the xla tier, and the pallas static fallback for the d == k class).
@@ -107,6 +109,15 @@ def _make_sharded_kernel(
     -> (g_h0, g_h1, g_dev, g_flat)`` replicated scalars, where
     ``B = n_devices * per_dev_batch`` and rows are sharded contiguously
     along ``axis_name``.
+
+    ``factored`` (ISSUE 16 satellite, xla only — the pallas branch
+    ignores it, see :func:`sharded_kernel_for`): the inner digit count
+    ``k_in`` of the outer/inner split, 0 = the baseline lane axis.  Each
+    SHARD runs the factored body locally — the outer-group scalar round
+    prefix and the per-group cache-resident schedule buffer are per-shard
+    properties, so the 2.76× single-device xla win (BENCH_pr14.json)
+    carries straight through the collective cascade, which is shape-
+    agnostic over the local ``(h0, h1, flat)`` it reduces.
 
     ``sieve=True`` is the PER-SHARD sieve (ISSUE 14 satellite): the fn
     takes an extra replicated uint32 ``thresh`` scalar; each shard runs
@@ -137,7 +148,8 @@ def _make_sharded_kernel(
 
     else:
         local = make_kernel_body(
-            n_tail_blocks, low_pos, k, per_dev_batch, rolled, sieve=sieve
+            n_tail_blocks, low_pos, k, per_dev_batch, rolled, sieve=sieve,
+            factored=factored,
         )
 
     def shard_fn(midstate, tail_const, bounds, *th):
@@ -247,6 +259,7 @@ def sharded_kernel_for(
     interpret: bool,
     rolled: bool,
     sieve: bool = False,
+    factored: bool = False,
 ):
     """Build (or fetch cached) the sharded kernel closure for one digit
     class: ``kern(midstate, tail_const, bounds, *th) -> (g_h0, g_h1,
@@ -254,7 +267,15 @@ def sharded_kernel_for(
     operand when ``sieve=True``, empty otherwise).  Shared by the
     synchronous sharded driver below and the mesh mode of
     ``ops.sweep.SweepPipeline``; dyn-kernel closures carry ``class_key``
-    for the pipeline's single-flight build locks."""
+    for the pipeline's single-flight build locks.
+
+    ``factored`` threads the outer/inner digit split into the xla
+    branch (classes with ``k >= 2``; a 1-digit lane axis has nothing to
+    factor).  The pallas branch IGNORES it: the sharded pallas tier
+    keeps the dyn kernels — the factored pallas kernel is per-class
+    static, giving back the digit-boundary compile amortization, and its
+    cost model can only be arbitrated on real TPU (the same follow-on as
+    the single-device pallas factored rung)."""
     low_pos = layout.digit_pos[layout.digit_count - group.k :]
     if backend == "pallas":
         from ..ops.pallas_sha256 import dyn_params
@@ -295,6 +316,26 @@ def sharded_kernel_for(
         interpret,
         rolled,
         sieve=sieve,
+        factored=(
+            default_factor_k_in(group.k)
+            if factored and group.k >= 2 and backend != "pallas"
+            else 0
+        ),
+    )
+
+
+def shard_operands(midstate, tail_const, bounds, mesh: Mesh, axis_name: str):
+    """Place one dispatch's chunk descriptor on the mesh, asynchronously:
+    rows sharded contiguously along ``axis_name``, midstate replicated.
+    Shared by :func:`sharded_invoke` and the hot plane's descriptor-ring
+    refills (``ops.sweep._HotLoop``), so both dispatch forms ship
+    byte-identical operand placements."""
+    row = NamedSharding(mesh, P(axis_name, None))
+    rep = NamedSharding(mesh, P())
+    return (
+        jax.device_put(midstate, rep),
+        jax.device_put(tail_const, row),
+        jax.device_put(bounds, row),
     )
 
 
@@ -302,23 +343,16 @@ def sharded_invoke(
     kern, midstate, tail_const, bounds, mesh: Mesh, axis_name: str,
     thresh=None,
 ):
-    """Queue one sharded dispatch: rows sharded contiguously along
-    ``axis_name``, midstate replicated.  ``thresh`` (per-shard sieve
-    kernels only): the host's running-min h0 as a plain int — replicated
-    to every shard as a uint32 scalar."""
-    row = NamedSharding(mesh, P(axis_name, None))
-    rep = NamedSharding(mesh, P())
+    """Queue one sharded dispatch (see :func:`shard_operands`).
+    ``thresh`` (per-shard sieve kernels only): the host's running-min h0
+    as a plain int — replicated to every shard as a uint32 scalar."""
     th = ()
     if thresh is not None:
         import numpy as _np
 
-        th = (jax.device_put(_np.uint32(thresh), rep),)
-    return kern(
-        jax.device_put(midstate, rep),
-        jax.device_put(tail_const, row),
-        jax.device_put(bounds, row),
-        *th,
-    )
+        th = (jax.device_put(_np.uint32(thresh), NamedSharding(mesh, P())),)
+    ops = shard_operands(midstate, tail_const, bounds, mesh, axis_name)
+    return kern(*ops, *th)
 
 
 def sweep_min_hash_sharded(
@@ -335,6 +369,8 @@ def sweep_min_hash_sharded(
     stats: Optional[dict] = None,
     workload=None,
     sieve: Optional[bool] = None,
+    factored: Optional[bool] = None,
+    hot: Optional[bool] = None,
 ) -> SweepResult:
     """Multi-chip ``(min Hash(data, n), argmin n)`` over inclusive
     ``[lower, upper]``; bit-exact vs the hashlib oracle, lowest-nonce ties.
@@ -353,6 +389,14 @@ def sweep_min_hash_sharded(
     last.  Bit-exact either way; the sharded tier no longer forces the
     baseline kernel.
 
+    ``factored`` (ISSUE 16 satellite, None = the :func:`auto_tune` rung):
+    the outer/inner digit split, threaded per-shard through the xla
+    sharded kernels — a mesh miner gets the single-device tier's 2.76×
+    win.  Ignored by the sharded pallas branch (dyn kernels; real-TPU
+    arbitration follow-on).  ``hot`` (ISSUE 16, None = the rung): the
+    always-hot device plane — donated replicated carry + descriptor-ring
+    refills via :func:`shard_operands` — wrapping the sharded kernels.
+
     ``stats``, if given, is filled with dispatch-overlap accounting:
     ``dispatches`` (count), ``fetch_wait_seconds`` (host time blocked on
     result fetches — near zero means enqueue fully overlapped compute).
@@ -363,11 +407,8 @@ def sweep_min_hash_sharded(
     mesh_on_tpu = is_tpu_device(mesh.devices.flat[0])
     if backend is None and not mesh_on_tpu:
         backend = "xla"
-    # Factoring stays off in the sharded tier (ops/sweep.py SweepPipeline
-    # mesh mode pins it the same way): the sharded kernels keep the
-    # baseline/dyn forms; a factored sharded tier is a ROADMAP follow-on.
-    backend, batch_per_device, max_k, sieve, _factored = auto_tune(
-        backend, batch_per_device, max_k, sieve, factored=False
+    backend, batch_per_device, max_k, sieve, factored, hot = auto_tune(
+        backend, batch_per_device, max_k, sieve, factored, hot
     )
     rolled = not mesh_on_tpu
     batch = n_dev * batch_per_device
@@ -375,15 +416,28 @@ def sweep_min_hash_sharded(
     def get_kernel(layout, group):
         return sharded_kernel_for(
             layout, group, batch_per_device, mesh, axis_name, backend,
-            interpret, rolled, sieve=sieve,
+            interpret, rolled, sieve=sieve, factored=factored,
         )
 
     if stats is not None:
         stats.update(dispatches=0, fetch_wait_seconds=0.0)
 
+    from ..ops.sweep import _HotLoop, _HotToken
+
+    hotloop = (
+        _HotLoop(
+            backend, sieve, mesh=mesh, axis_name=axis_name,
+            per_dev_batch=batch_per_device,
+        )
+        if hot
+        else None
+    )
+
     def run_kernel(kern, midstate, tail_const, bounds):
         if stats is not None:
             stats["dispatches"] += 1
+        if hotloop is not None:
+            return hotloop.dispatch(kern, midstate, tail_const, bounds)
         th = None
         if sieve:
             # Enqueue-time running-min h0; a stale (looser) read is
@@ -402,6 +456,9 @@ def sweep_min_hash_sharded(
             cand = (out.hash, out.nonce)
             if not best or cand < best[0]:
                 best[:] = [cand]
+            return
+        if isinstance(out, _HotToken):
+            hotloop.drain(out, bases, n_lanes)
             return
         h0, h1, dev, flat = out
         if stats is not None:
@@ -424,6 +481,10 @@ def sweep_min_hash_sharded(
         data, lower, upper, max_k, batch, get_kernel, run_kernel, consume,
         sep=sep, host_min=host_min,
     )
+    if hotloop is not None:
+        cand = hotloop.finish()
+        if cand is not None and (not best or cand < best[0]):
+            best[:] = [cand]
     if not best:
         raise RuntimeError("sharded sweep produced no candidates")
     return SweepResult(hash=best[0][0], nonce=best[0][1], lanes_swept=lanes)

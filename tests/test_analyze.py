@@ -203,6 +203,16 @@ def test_metrics_rules_fire_on_fixture():
     assert ("metric-unused", "ingress.fixture_events") in {
         (f.rule, f.symbol) for f in findings
     }
+    # kernel.thresh_staleness is the hot plane's threshold-lag gauge
+    # (ISSUE 16) — the one gauge-kind name under kernel.* — and the
+    # sweep.* hot-plane counter family rides the same registry
+    # cross-check (inc-kind).
+    assert ("metric-kind-mismatch", "kernel.thresh_staleness") in {
+        (f.rule, f.symbol) for f in findings
+    }
+    assert ("metric-unused", "sweep.fixture_refills") in {
+        (f.rule, f.symbol) for f in findings
+    }
 
 
 def test_metrics_pass_honors_metric_ok_declaration(tmp_path):
@@ -331,6 +341,32 @@ def test_trace_pass_collects_factored_kernel_bodies():
     # factored jit wrapper join the static + dyn ones.
     assert collected["ops/pallas_sha256.py"].count("kernel") >= 2
     assert collected["ops/pallas_sha256.py"].count("minhash") >= 3
+
+
+def test_trace_pass_collects_hot_step_bodies():
+    """ISSUE 16 coverage meta-test: the trace-safety lint must SEE the
+    always-hot plane's donated ring-loop step bodies.  ``make_hot_step``
+    builds one jitted ``step`` per backend variant (xla / pallas / mesh)
+    plus the shared ``_merge`` carry combine — all of them trace with a
+    carried device threshold, so the concretize/branch/wallclock rules
+    must gate them exactly like the kernels they wrap.  If a refactor
+    renames the factory outside the ``|hot`` convention, this test (not
+    silence) fails."""
+    import ast
+
+    from tools.analyze.common import file_comments
+    from tools.analyze.tracecheck import FACTORY_RE, _collect_kernel_bodies
+
+    # The hot factory naming is part of the convention now.
+    assert FACTORY_RE.search("make_hot_step")
+    src = (REPO / "bitcoin_miner_tpu" / "ops" / "sweep.py").read_text()
+    names = [
+        fn.name
+        for fn in _collect_kernel_bodies(ast.parse(src), file_comments(src))
+    ]
+    # All three backend-variant step bodies and the carry combine.
+    assert names.count("step") >= 3
+    assert "_merge" in names
 
 
 # --------------------------------------------------------------------------

@@ -114,6 +114,33 @@ def test_factor_compare_fast_leg():
     assert out["kept_kernel"] in ("baseline", "factored")
 
 
+def test_hot_compare_fast_leg():
+    """``--hot-compare --fast`` (ISSUE 16): the tier-1 correctness leg
+    of the persistent-vs-per-chunk dispatch comparison — both disciplines
+    oracle-gated on a digit-boundary range, the interpret-mode pallas hot
+    plane (plain and sieve-composed, threshold device-carried) included,
+    and the JSON honest about which dispatch auto_tune keeps
+    (BENCH_pr16.json is the full-speed artifact)."""
+    p = run_bench("--hot-compare", "--fast", "--cpu")
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = p.stdout.strip().splitlines()
+    assert len(lines) == 1, lines
+    out = json.loads(lines[0])
+    assert out["metric"] == "hot_compare"
+    assert out["bitexact"] is True
+    assert out["interpret_pallas_hot_bitexact"] is True
+    assert out["perchunk_nps"] > 0 and out["hot_nps"] > 0
+    assert out["fast"] is True
+    # The honesty contract here is SELF-consistency: the JSON must record
+    # exactly what auto_tune picks for this backend.  (No ratio→kept
+    # coupling: the hot rung is calibrated on the FULL-SPEED same-seed
+    # pair — BENCH_pr16.json — and the --fast leg's tiny window under
+    # tier-1 load is a correctness gate, not a measurement; asserting on
+    # its noisy ratio would flake.)
+    assert out["auto_tune_hot"] == (out["kept_kernel"] == "hot")
+    assert out["kept_kernel"] in ("per-chunk", "hot")
+
+
 def test_cpu_bench_emits_one_valid_json_line():
     p = run_bench("--cpu")
     assert p.returncode == 0, p.stderr[-2000:]

@@ -131,6 +131,84 @@ def test_mesh_pipeline_per_shard_sieve_matches_oracle():
         p.close()
 
 
+def test_sharded_factored_matches_oracle():
+    # Factored sharded tier (ISSUE 16 satellite): the outer/inner digit
+    # split now threads through _make_sharded_kernel, so mesh xla miners
+    # get the per-group schedule-buffer shrink that won 2.76x on the
+    # single-device tier.  Same shard_map + collective cascade, with the
+    # factored kernel's remapped global flat index feeding the per-device
+    # argmin — bit-exact, lowest-nonce ties included.
+    r = sweep_min_hash_sharded(
+        "cmu440", 1000, 2234, backend="xla", max_k=2, batch_per_device=2,
+        factored=True,
+    )
+    assert (r.hash, r.nonce) == min_hash_range("cmu440", 1000, 2234)
+    assert r.lanes_swept == 2234 - 1000 + 1
+
+
+def test_sharded_factored_digit_boundary():
+    # k=1 leaves nothing to factor (k_in=0 -> baseline fallback) on one
+    # side of the boundary; the d=3 class factors.  Both shapes sharded.
+    r = sweep_min_hash_sharded(
+        "x", 95, 305, backend="xla", max_k=1, batch_per_device=2,
+        factored=True,
+    )
+    assert (r.hash, r.nonce) == min_hash_range("x", 95, 305)
+
+
+def test_sharded_factored_sieve_composition():
+    # Factored + per-shard sieve, sharded: pass 1 and pass 2 resume from
+    # ONE shared group prefix inside each shard, the dispatch threshold
+    # replicated ahead of the cascade.
+    r = sweep_min_hash_sharded(
+        "cmu440", 1000, 2234, backend="xla", max_k=2, batch_per_device=2,
+        factored=True, sieve=True,
+    )
+    assert (r.hash, r.nonce) == min_hash_range("cmu440", 1000, 2234)
+
+
+def test_sharded_hot_matches_oracle():
+    # The always-hot plane over the mesh (ISSUE 16): donated replicated
+    # carry merged AFTER the collective cascade, the carried best_dev
+    # scaling the row exactly like the per-chunk sharded fold.  The xla
+    # leg rides the factored sharded default.
+    r = sweep_min_hash_sharded(
+        "cmu440", 1000, 2234, backend="xla", max_k=2, batch_per_device=2,
+        sieve=True, hot=True,
+    )
+    assert (r.hash, r.nonce) == min_hash_range("cmu440", 1000, 2234)
+    assert r.lanes_swept == 2234 - 1000 + 1
+
+
+def test_sharded_hot_digit_boundary():
+    r = sweep_min_hash_sharded(
+        "x", 95, 305, backend="xla", max_k=1, batch_per_device=2, hot=True
+    )
+    assert (r.hash, r.nonce) == min_hash_range("x", 95, 305)
+
+
+def test_mesh_pipeline_hot_matches_oracle():
+    # SweepPipeline mesh mode with the hot plane on: back-to-back jobs,
+    # one donated carry per job, tokens through the same fetch queue.
+    from bitcoin_miner_tpu.ops.sweep import SweepPipeline
+
+    p = SweepPipeline(
+        backend="xla", mesh=default_mesh(8), max_k=2, batch=2,
+        host_lane_budget=0, sieve=True, hot=True,
+    )
+    try:
+        futs = [
+            p.submit("cmu440", 1000, 2234),
+            p.submit("cmu440", 2235, 3499),
+        ]
+        wants = [("cmu440", 1000, 2234), ("cmu440", 2235, 3499)]
+        for f, (d, lo, hi) in zip(futs, wants):
+            r = f.result(timeout=300)
+            assert (r.hash, r.nonce) == min_hash_range(d, lo, hi), (d, lo, hi)
+    finally:
+        p.close()
+
+
 def test_sharded_matches_single_device_tier():
     from bitcoin_miner_tpu.ops.sweep import sweep_min_hash
 

@@ -62,11 +62,18 @@ _METRICS_ASSIGN = re.compile(r"^METRICS\s*=", re.MULTILINE)
 def _name_kind(name: str) -> str:
     if name.startswith("hist."):
         return "hist"
-    if name.startswith(("gauge.", "fleet.", "fed.peer_state", "gw.conns_live")):
+    if name.startswith(
+        (
+            "gauge.", "fleet.", "fed.peer_state", "gw.conns_live",
+            "kernel.thresh_staleness",
+        )
+    ):
         # fed.peer_state[.<peer>] is the per-peer membership gauge family
         # (ISSUE 12); the rest of fed.* stays counter-kind.  gw.conns_live
         # is the ingress live-conn gauge (ISSUE 15) — the only gauge-kind
-        # name under gw.*.
+        # name under gw.*.  kernel.thresh_staleness is the hot plane's
+        # sieve-threshold lag level (ISSUE 16) — the one gauge-kind name
+        # under kernel.*, while sweep.* stays counter-kind.
         return "gauge"
     return "counter"
 

@@ -9,11 +9,14 @@ inside **kernel bodies** — functions it identifies as jit-traced:
 - decorated with ``@jax.jit`` / ``@partial(jax.jit, ...)``,
 - passed by name to ``jax.jit(...)`` in the same module,
 - defined (at any nesting depth) inside a kernel factory — a function
-  whose name matches ``(make|build).*(kernel|minhash|sieve|call)``, the
-  repo's factory convention (``make_kernel_body``, ``_build_call``,
-  ``_make_sharded_kernel``, and the ISSUE 13 sieve factories — both of
-  the two-stage sieve's passes live inside these bodies on both
-  backends, so the race/contract checks gate them like the old code),
+  whose name matches ``(make|build).*(kernel|minhash|sieve|factored|
+  hot|call)``, the repo's factory convention (``make_kernel_body``,
+  ``_build_call``, ``_make_sharded_kernel``, the ISSUE 13 sieve
+  factories — both of the two-stage sieve's passes live inside these
+  bodies on both backends, so the race/contract checks gate them like
+  the old code — the ISSUE 14 factored factories, and the ISSUE 16 hot
+  plane's ``make_hot_step``, whose donated ring-loop step bodies trace
+  like any kernel body),
 - or explicitly marked with ``# jit-kernel`` on its def line.
 
 Rules (suppress a deliberate line with ``# trace-ok: <reason>``):
@@ -61,7 +64,12 @@ from .common import (
 
 PASS = "trace"
 
-FACTORY_RE = re.compile(r"(make|build).*(kernel|minhash|sieve|factored|call)")
+#: Kernel-factory naming convention the lint keys on; ``hot`` (ISSUE 16)
+#: admits the always-hot plane's donated-step factories (make_hot_step),
+#: whose ring-loop step bodies trace like any kernel body.
+FACTORY_RE = re.compile(
+    r"(make|build).*(kernel|minhash|sieve|factored|hot|call)"
+)
 
 #: Default scan scope in repo mode: the accelerator layers.
 TRACE_SCAN_DIRS = (
