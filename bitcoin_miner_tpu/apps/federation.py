@@ -60,10 +60,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     # event-loop front end — O(1) threads in live conns (env spelling
     # BMT_ASYNC_INGRESS, like apps.server; "" and "0" mean OFF).
     async_public = os.environ.get("BMT_ASYNC_INGRESS", "") not in ("", "0")
+    # Self-scaling capacity plane (ISSUE 18): --autoscale[=SPEC] arms the
+    # in-cell controller — axis a spawns/clean-drains miner workers
+    # against this cell's public port; ``cell_drain=N`` in the spec arms
+    # axis b (a cell cold at its worker floor hands off early through
+    # the ISSUE 12 membership drain and exits, same path as SIGTERM).
+    autoscale_conf = os.environ.get("BMT_AUTOSCALE") or None
     pos = []
     for a in argv[1:]:
         if a == "--async-ingress":
             async_public = True
+        elif a == "--autoscale":
+            autoscale_conf = "1"
+        elif a.startswith("--autoscale="):
+            autoscale_conf = a.split("=", 1)[1]
         elif a.startswith("--cell="):
             cell = a.split("=", 1)[1]
         elif a.startswith("--fed-port="):
@@ -101,6 +111,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print("Bad argument:", e)
         return 0
+    as_cfg = as_driver = None
+    if autoscale_conf:
+        from ..autoscale import parse_autoscale_config
+
+        try:
+            as_cfg, as_driver = parse_autoscale_config(autoscale_conf)
+        except ValueError as e:
+            print("Bad argument:", e)
+            return 0
     # One log file per cell — two replicas in one cwd must not interleave.
     logging.basicConfig(
         filename=f"log.{cell}.txt",
@@ -153,12 +172,49 @@ def main(argv: Optional[List[str]] = None) -> int:
     # loses no resumable progress.  SIGKILL remains the crash drill.
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda signum, frame: stop.set())
+    # In-cell autoscale controller (ISSUE 18).  The cell has no telemetry
+    # hub here, so there is no burn evidence — the up axis stays quiet
+    # (burn None = unknown) and the controller works the quiet side:
+    # clean-draining spare workers down to the floor, then (cell_drain=N)
+    # handing the whole cell off.  The drain latch sets ``stop`` so the
+    # binary exits through the same path as SIGTERM — replica.drain() is
+    # idempotent, so the second call below is harmless.
+    pump = None
+    workers = None
+    if as_cfg is not None:
+        from ..autoscale import (
+            AutoscaleController,
+            CellActuator,
+            ControllerPump,
+            GatewayWeightActuator,
+            ProcessActuator,
+        )
+        from ..utils.metrics import METRICS
+
+        workers = ProcessActuator(
+            replica.port, backend=as_driver["backend"]
+        )
+        controller = AutoscaleController(
+            workers,
+            burn=lambda: None,
+            utilization=lambda: METRICS.gauges().get("fleet.utilization"),
+            weights=GatewayWeightActuator(replica.gateway, replica.lock),
+            cell=CellActuator(replica, on_drained=stop.set),
+            config=as_cfg,
+        )
+        pump = ControllerPump(
+            controller, interval=as_driver["interval"]
+        ).start()
     try:
         while not stop.wait(0.5):
             pass
     except KeyboardInterrupt:
         pass
     finally:
+        if pump is not None:
+            pump.stop()
+        if workers is not None:
+            workers.stop_all()
         if stop.is_set():
             print(f"Replica {cell} draining", flush=True)
             replica.drain(reason="SIGTERM")

@@ -257,7 +257,12 @@ def make_async_search(
     return _PipelineSearch(backend, devices=devices, hot=hot)
 
 
-def run_miner(client: "lsp.Client", search, close_search: bool = True) -> bool:
+def run_miner(
+    client: "lsp.Client",
+    search,
+    close_search: bool = True,
+    drain: Optional["threading.Event"] = None,
+) -> bool:
     """Join and serve Requests until the server connection dies (the
     reference miner's intended lifetime: exit on server loss).
     ``close_search=False`` keeps an externally-owned async search alive
@@ -266,6 +271,13 @@ def run_miner(client: "lsp.Client", search, close_search: bool = True) -> bool:
     Returns True if the exit was a (reconnect-worthy) connection loss,
     False if the search backend itself failed — a broken backend must stop
     the miner, not send it into a join/fail/reconnect churn.
+
+    ``drain`` (ISSUE 18, the autoscaler's clean scale-down): once set,
+    the loop finishes every chunk ALREADY RECEIVED, writes their Results,
+    and returns — nothing accepted is abandoned, so the only chunks the
+    scheduler re-assigns are ones this miner never delivered, and a
+    resumed job sweeps strictly fewer nonces than after a kill.  The
+    miner binary arms this from its SIGTERM handler.
 
     ``search`` is either a plain ``(data, lo, hi) -> (hash, nonce)``
     callable (wrapped in a one-worker pool) or an async object with
@@ -317,7 +329,25 @@ def run_miner(client: "lsp.Client", search, close_search: bool = True) -> bool:
     t.start()
     try:
         while True:
-            item = inflight.get()
+            if drain is None:
+                item = inflight.get()
+            elif drain.is_set():
+                try:
+                    # Drain mode: serve out whatever the reader already
+                    # queued; an EMPTY queue means every received chunk's
+                    # Result is written — exit, leaving the reader (daemon,
+                    # parked in read()) to die with the conn/process.
+                    item = inflight.get_nowait()
+                except _queue.Empty:
+                    trace.emit(None, "miner", "drained")
+                    return True
+            else:
+                try:
+                    # Armed but not signalled: poll so a SIGTERM between
+                    # chunks is noticed without a Request arriving.
+                    item = inflight.get(timeout=0.25)
+                except _queue.Empty:
+                    continue
             if item is None:
                 return True
             fut, msg = item
@@ -356,6 +386,7 @@ def run_miner_resilient(
     label: Optional[str] = None,
     first_client: Optional["lsp.Client"] = None,
     stop: Optional["threading.Event"] = None,
+    drain: Optional["threading.Event"] = None,
     sleep=None,
 ) -> None:
     """Self-healing miner lifetime: Join/serve until the server connection
@@ -369,9 +400,11 @@ def run_miner_resilient(
     transient partitions but still exits once the server is gone for good.
     ``stop`` (an Event) ends the lifetime at the next reconnect decision —
     harnesses use it so torn-down fleets don't leave reconnect loops
-    dialing a dead port.  One async ``search`` (and its warm kernel
-    compiles) is reused across connections; plain callables are wrapped
-    once.
+    dialing a dead port.  ``drain`` is the clean scale-down signal
+    forwarded into :func:`run_miner` — once set, the current connection
+    finishes its received chunks and the lifetime ends (no reconnect).
+    One async ``search`` (and its warm kernel compiles) is reused across
+    connections; plain callables are wrapped once.
     """
     import time as _time
 
@@ -420,13 +453,17 @@ def run_miner_resilient(
             connected_before = True
             conn_lost = False
             try:
-                conn_lost = run_miner(client, asearch, close_search=False)
+                conn_lost = run_miner(
+                    client, asearch, close_search=False, drain=drain
+                )
             finally:
                 try:
                     client.close()
                 except lsp.LspError:
                     pass
                 client = None
+            if drain is not None and drain.is_set():
+                return  # clean drain: received work delivered; don't rejoin
             if not conn_lost:
                 # The search backend failed, not the network: reconnecting
                 # would just churn join/fail forever against a live server.
@@ -759,6 +796,15 @@ def main(argv=None) -> int:
     # wedge timeout.
     parser.add_argument("--reconnect", type=int, default=5)
     parser.add_argument("--watchdog", type=float, default=None)
+    # Paced-capacity mode (ISSUE 18): sweep at a FIXED nonces/s (sleep-
+    # dominated, not CPU-bound), so N workers on one box model N units of
+    # capacity — the substrate the autoscale bench's open-loop overload
+    # leg needs (tools/fleet_bench.py --autoscale stamps the pace into
+    # its JSON line).  BMT_MINER_THROTTLE_NPS is the env spelling.
+    parser.add_argument(
+        "--throttle-nps", type=float,
+        default=float(os.environ.get("BMT_MINER_THROTTLE_NPS", "0") or 0),
+    )
     # Registered range-fold workload (ISSUE 9): the hash family this
     # miner sweeps.  Must match the server's --workload (the wire never
     # names workloads); BMT_WORKLOAD is the env spelling for subprocess
@@ -830,6 +876,21 @@ def main(argv=None) -> int:
         return 0
     import time as _time
 
+    if args.throttle_nps and args.throttle_nps > 0:
+        _paced = search
+        _rate = float(args.throttle_nps)
+
+        class _PacedSearch:
+            # The sleep rides the reader thread's submit call, pacing the
+            # whole pipeline at ``_rate`` without holding a core.
+            def submit(self, d, lo, hi):
+                _time.sleep((hi - lo + 1) / _rate)
+                return _paced.submit(d, lo, hi)
+
+            def close(self):
+                _paced.close()
+
+        search = _PacedSearch()
     if os.environ.get("BMT_MINER_LOG"):
         # Operator observability: per-chunk submit/resolve timing on stderr
         # (used by tools/fleet_bench.py --miner-log to audit fleet cadence).
@@ -879,7 +940,21 @@ def main(argv=None) -> int:
     except (lsp.LspError, OSError, ValueError) as e:
         print("Failed to join with server:", e)
         return 0
+    import signal
+    import threading
     import time
+
+    # Clean-drain signal (ISSUE 18): the autoscaler retires a worker with
+    # SIGTERM; the handler only sets an Event — the serve loop finishes
+    # every chunk already received, writes their Results, and exits 0,
+    # so a drained worker's job resumes with strictly fewer nonces left
+    # than after a kill.  Best-effort: installing a handler needs the
+    # main thread (tests drive main() elsewhere — they keep the default).
+    drain_evt = threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda _s, _f: drain_evt.set())
+    except ValueError:
+        pass
 
     t0 = time.monotonic()
     try:
@@ -887,9 +962,10 @@ def main(argv=None) -> int:
             run_miner_resilient(
                 host or "127.0.0.1", int(port), search,
                 max_retries=args.reconnect, first_client=client,
+                stop=drain_evt, drain=drain_evt,
             )
         else:
-            run_miner(client, search)
+            run_miner(client, search, drain=drain_evt)
     finally:
         if exporter is not None:
             exporter.stop()

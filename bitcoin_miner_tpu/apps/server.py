@@ -298,6 +298,12 @@ class _EventPlane:
                 # 10x+ per replica at O(1) threads.
                 if self._conns_live is not None:
                     METRICS.set_gauge("gw.conns_live", float(self._conns_live()))
+                # Federation transport surface (ISSUE 18): fed-port +
+                # gossip conns ride the cell's one shared loop, so this
+                # conn count is the thing that grows with peers while
+                # the thread count stays flat.
+                if "fed_conns" in st:
+                    METRICS.set_gauge("fed.conns_live", float(st["fed_conns"]))
                 # Fleet metrics plane (ISSUE 7): merge this process's
                 # registry into the fleet view, evaluate SLO burn rates,
                 # run the straggler detector, feed the publish sinks.
@@ -817,6 +823,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     # — O(1) threads in live conns.  Same engine, same contracts.  Env
     # convention matches BMT_SANITIZE: "" and "0" mean OFF.
     async_ingress = os.environ.get("BMT_ASYNC_INGRESS", "") not in ("", "0")
+    # Self-scaling capacity plane (ISSUE 18): --autoscale[=SPEC] arms the
+    # SLO-burn-driven controller against THIS serving port — spawning /
+    # clean-draining miner worker subprocesses off the hub's burn alerts
+    # and the fleet.utilization gauge, and (gateway on) re-weighting WFQ
+    # tenants under overload.  BMT_AUTOSCALE is the subprocess-bench env
+    # spelling; SPEC grammar is autoscale.parse_autoscale_config's.
+    autoscale_conf = os.environ.get("BMT_AUTOSCALE") or None
     pos = []
     for a in argv[1:]:
         if a.startswith("--checkpoint="):
@@ -849,6 +862,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             slo_conf = a.split("=", 1)[1]
         elif a.startswith("--workload="):
             workload_name = a.split("=", 1)[1]
+        elif a == "--autoscale":
+            autoscale_conf = "1"
+        elif a.startswith("--autoscale="):
+            autoscale_conf = a.split("=", 1)[1]
         elif a == "--gateway":
             gateway_on = True
         elif a.startswith("--cache="):
@@ -881,6 +898,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print("Port must be a number:", e)
         return 0
+    # Parse the autoscale policy up front: a spec typo must fail fast,
+    # before anything binds a port or spawns a thread.
+    as_cfg = as_driver = None
+    if autoscale_conf:
+        from ..autoscale import parse_autoscale_config
+
+        try:
+            as_cfg, as_driver = parse_autoscale_config(autoscale_conf)
+        except ValueError as e:
+            print(str(e))
+            return 0
     server = None
     if not async_ingress:
         try:
@@ -993,17 +1021,67 @@ def main(argv: Optional[List[str]] = None) -> int:
             if server is not None:
                 server.close()
             return 0
+    # Self-scaling capacity plane (ISSUE 18): the controller reads the
+    # hub's burn verdicts and the fleet.utilization gauge each beat and
+    # actuates miner worker subprocesses against the live serving port
+    # (plus the gateway's WFQ tenant weights when both are armed).  The
+    # event lock is created HERE when autoscale is on and passed to the
+    # shell, so the weight actuator and the serve plane hold the SAME
+    # lock.  Arming waits for the live port (the ingress binds in
+    # start()), hence the closure.
+    pump = None
+    workers = None
+    ev_lock = threading.Lock() if as_cfg is not None else None
+
+    def _arm_autoscale(live_port: int) -> None:
+        nonlocal pump, workers
+        from ..autoscale import (
+            AutoscaleController,
+            ControllerPump,
+            GatewayWeightActuator,
+            ProcessActuator,
+        )
+
+        workers = ProcessActuator(
+            live_port,
+            backend=as_driver["backend"],
+            telemetry=f"127.0.0.1:{tport}" if tport else None,
+        )
+        weights = None
+        if gateway_on and as_cfg.overload_weights:
+            weights = GatewayWeightActuator(sched, ev_lock)
+        if hub is not None:
+            def _burn():
+                slo_state = (hub.last_state() or {}).get("slo") or {}
+                return slo_state.get("alerts")
+        else:
+            def _burn():
+                return None  # no SLO evidence: the up axis stays quiet
+        controller = AutoscaleController(
+            workers,
+            burn=_burn,
+            utilization=lambda: METRICS.gauges().get("fleet.utilization"),
+            weights=weights,
+            config=as_cfg,
+        )
+        if hub is not None:
+            # The dash panel's feed: controller state rides the fleet log.
+            hub.add_extra("autoscale", controller.status)
+        pump = ControllerPump(controller, interval=as_driver["interval"]).start()
+
     try:
         if async_ingress:
             try:
                 ingress = AsyncIngress(
                     port, scheduler=sched, checkpoint_path=checkpoint_path,
-                    telemetry=hub,
+                    telemetry=hub, lock=ev_lock,
                 ).start()
             except OSError as e:
                 print(str(e))
                 return 0
             print("Server listening on port", ingress.port)
+            if as_cfg is not None:
+                _arm_autoscale(ingress.port)
             try:
                 # The engine runs on the ingress loop + ticker; this
                 # thread just waits for shutdown (Ctrl-C / SIGTERM).
@@ -1017,11 +1095,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # shell where the same exception propagates out of serve().
                 raise ingress.error
         else:
+            if as_cfg is not None:
+                _arm_autoscale(server.port)
             serve(
                 server, scheduler=sched, checkpoint_path=checkpoint_path,
-                telemetry=hub,
+                telemetry=hub, lock=ev_lock,
             )
     finally:
+        if pump is not None:
+            pump.stop()
+        if workers is not None:
+            workers.stop_all()
         if hub is not None:
             hub.close()
         if server is not None:

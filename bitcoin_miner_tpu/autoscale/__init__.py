@@ -1,0 +1,22 @@
+"""Self-scaling capacity plane (ISSUE 18): the SLO-burn-driven
+autoscaler closing the loop from burn-rate alerts (utils/slo) and
+``fleet.utilization`` to fleet actions — worker spawn/clean-drain,
+federation-cell early handoff, and WFQ tenant re-weighting.
+
+- :mod:`.controller` — the pure-policy state machine (hold/cooldown/
+  retry semantics; externally serialized, deterministic under test).
+- :mod:`.actuator` — the world-touching axes + the wall-clock pump.
+- ``python -m tools.autoscale`` — the out-of-process supervisor CLI.
+"""
+
+from .actuator import (  # noqa: F401
+    CellActuator,
+    ControllerPump,
+    GatewayWeightActuator,
+    ProcessActuator,
+)
+from .controller import (  # noqa: F401
+    AutoscaleConfig,
+    AutoscaleController,
+    parse_autoscale_config,
+)

@@ -310,6 +310,7 @@ class SpanGossip:
         params: Optional["lsp.Params"] = None,
         membership=None,
         hb_fn=None,
+        loop=None,
     ) -> None:
         if interval <= 0:
             raise ValueError(f"interval must be positive, got {interval}")
@@ -324,6 +325,11 @@ class SpanGossip:
         self.params = params
         self.membership = membership
         self.hb_fn = hb_fn  # () -> {"inc": int, "load": str} | None
+        #: Shared loop thread for the peer conns (ISSUE 18): the replica
+        #: passes its forwarder loop so N gossip conns cost state, not N
+        #: private loop threads — the last O(peers) thread cost in a
+        #: cell.  None (bare daemons, tests) keeps one loop per conn.
+        self.loop = loop
         #: Largest gossip datagram written so far (the wire-ceiling
         #: acceptance surface — benches and tests assert it stays under
         #: the frozen 1000-byte limit with envelope headroom).
@@ -476,7 +482,8 @@ class SpanGossip:
             host, port = self.peers[name]
             try:
                 client = lsp.Client(
-                    host, port, self.params, label=f"gossip-{self.cell}"
+                    host, port, self.params, label=f"gossip-{self.cell}",
+                    loop=self.loop,
                 )
             except (lsp.LspError, OSError):
                 return False

@@ -209,7 +209,16 @@ class _Router:
 
     def stats(self) -> Dict[str, int]:
         st = self.gw.stats()
-        st.update(fed_peers=len(self._r.peers), fed_queue=self._r._fwd_q.qsize())
+        st.update(
+            fed_peers=len(self._r.peers),
+            fed_queue=self._r._fwd_q.qsize(),
+            # Live peer conns at the federation transport (ISSUE 18):
+            # the ``fed.conns_live`` gauge source, published by the
+            # serve ticker — the shared-loop refactor made conns cost
+            # state instead of threads, so the health surface must count
+            # conns, not threads.
+            fed_conns=self._r.fed.conns_live(),
+        )
         return st
 
     def drain_evictions(self) -> List[int]:
@@ -303,7 +312,17 @@ class Replica:
             None if self._async_public
             else lsp.Server(port, params, host=host, label=cell)
         )
-        self.fed = lsp.Server(fed_port, params, host=host, label=f"fed-{cell}")
+        # ONE shared loop thread carries the federation port, every
+        # forwarder worker's peer conns AND the gossip daemon's peer
+        # conns (ISSUE 15 → ISSUE 18): peer-facing transport used to
+        # cost a loop thread per gossip conn plus one for the fed
+        # server, which multiplied thread counts instead of capacity as
+        # cells were added — now a cell's thread count is O(1) in peers.
+        self._fwd_loop = lsp.shared_loop(f"fwd-loop-{cell}")
+        self.fed = lsp.Server(
+            fed_port, params, host=host, label=f"fed-{cell}",
+            loop=self._fwd_loop,
+        )
         # The cell's range-fold workload (ISSUE 9) stamps every state
         # file below; every cell of one federation must agree.
         wname = getattr(workload, "name", None)
@@ -345,7 +364,7 @@ class Replica:
             cell, self.spans, self.peers, self.lock,
             interval=gossip_interval, full_every=gossip_full_every,
             params=params, membership=self.membership,
-            hb_fn=self._heartbeat,
+            hb_fn=self._heartbeat, loop=self._fwd_loop,
         )
         self._tick_interval = tick_interval
         self._checkpoint_path = checkpoint_path
@@ -368,11 +387,6 @@ class Replica:
         self._fwd_conns: set = set()  # guarded-by: lock
         self._down_lock = threading.Lock()
         self._down: Dict[str, float] = {}  # guarded-by: _down_lock
-        # ONE shared loop thread carries every forwarder worker's peer
-        # conns (ISSUE 15): the pool used to cost a loop thread PER
-        # cached conn (workers x peers), which multiplied thread counts
-        # instead of capacity as cells were added.  Created in start().
-        self._fwd_loop = None
         self._threads: List[threading.Thread] = []
         self._started = False
 
@@ -413,7 +427,6 @@ class Replica:
             )
             t.start()
             self._threads.append(t)
-        self._fwd_loop = lsp.shared_loop(f"fwd-loop-{self.cell}")
         ti = threading.Thread(
             target=self._fed_ingest, name=f"fed-ingest-{self.cell}", daemon=True
         )

@@ -132,6 +132,14 @@ class Gateway:
         self._queued_conns: set = set()
         self._queue = FairQueue()
         self._buckets: Dict[str, TokenBucket] = {}
+        # Tenant weight overrides (ISSUE 18, autoscale axis c): the
+        # controller re-weights WFQ tenants under SLO burn so paying
+        # traffic starves last, and clears the overrides on recovery.
+        # Applied wherever a client key meets a virtual clock — the
+        # admission FairQueue push and the scheduler tenant WFQ submit —
+        # so the next enqueue under a principal carries the new weight
+        # (utils/wfq: the latest submission's weight wins).
+        self._tenant_weights: Dict[str, float] = {}
         self._shed: List[int] = []
         #: Monotone per-GATEWAY shed count (the process METRICS counter is
         #: shared by every in-process cell): the federation heartbeat's
@@ -373,6 +381,28 @@ class Gateway:
         publishes it as ``gauge.gw_vt_floor``)."""
         return self._queue.vt_floor()
 
+    def set_tenant_weights(self, weights: Dict[str, float]) -> None:
+        """Install the autoscaler's WFQ weight overrides (client key →
+        weight, replacing any previous override map).  Takes effect on
+        each principal's NEXT enqueue — queue push or scheduler submit —
+        via the WFQ latest-submission-wins rule; under the overload that
+        triggers a re-weight that is immediate in practice."""
+        self._tenant_weights = {
+            k: float(w) for k, w in weights.items() if w > 0.0
+        }
+
+    def clear_tenant_weights(self) -> None:
+        """Drop every override (recovery): tenants return to unit weight
+        on their next enqueue."""
+        self._tenant_weights = {}
+
+    def tenant_weights(self) -> Dict[str, float]:
+        """The live override map (dash/status surface; copy, not view)."""
+        return dict(self._tenant_weights)
+
+    def _weight_of(self, client_key: str) -> float:
+        return self._tenant_weights.get(client_key, 1.0)
+
     def stats(self) -> Dict[str, int]:
         st = self.sched.stats()
         st.update(
@@ -473,6 +503,7 @@ class Gateway:
         return pre + self._translate(
             self.sched.client_request(
                 vid, data, lower, upper, now, tenant=client_key,
+                weight=self._weight_of(client_key),
                 gaps=gaps, seed_best=seed, trace=trace,
             ),
             now,
@@ -555,7 +586,7 @@ class Gateway:
                 self._submit(conn_id, key, ckey, now, trace=tid, t_req=t_enq)
             )
         for ckey, item in deferred:
-            self._queue.push(ckey, item)
+            self._queue.push(ckey, item, self._weight_of(ckey))
         # Even with every slot full, queued twins of an in-flight or solved
         # signature need no slot of their own — resolve them now instead of
         # leaving them parked a full completion cycle (the pred coalesces /
@@ -717,7 +748,7 @@ class Gateway:
             self._shed.append(victim[0])
             _trace.emit(victim[3], "gw", "shed", conn=victim[0])
         METRICS.inc("gateway.throttled")
-        self._queue.push(ckey, item)
+        self._queue.push(ckey, item, self._weight_of(ckey))
         self._queued_conns.add(conn_id)
         _trace.emit(tid, "gw", "queued", backlog=len(self._queue))
 

@@ -205,19 +205,29 @@ class Client:
 
 
 class Server:
-    """Blocking LSP server (API parity: lsp/server_api.go:6-39)."""
+    """Blocking LSP server (API parity: lsp/server_api.go:6-39).
+
+    ``loop`` (ISSUE 18) borrows a :func:`shared_loop` instead of spawning
+    a private loop thread, exactly like :class:`Client`: the federation
+    port rides its cell's one forwarder loop so a cell's thread count is
+    O(1) in peers.  ``close()`` leaves a borrowed loop alive for its
+    owner to stop."""
 
     def __init__(
         self, port: int, params: Optional[Params] = None, host: str = "127.0.0.1",
-        label: Optional[str] = None,
+        label: Optional[str] = None, loop: Optional[_LoopThread] = None,
     ) -> None:
-        self._lt = _LoopThread(f"lsp-server-:{port}")
+        self._owns_loop = loop is None
+        self._lt = loop if loop is not None else _LoopThread(
+            f"lsp-server-:{port}"
+        )
         try:
             self._s: AsyncServer = self._lt.run(
                 AsyncServer.create(port, params, host, label=label)
             )
         except BaseException:
-            self._lt.stop()
+            if self._owns_loop:
+                self._lt.stop()
             raise
 
     @property
@@ -251,10 +261,12 @@ class Server:
         self._lt.call(self._s.close_conn, conn_id)
 
     def close(self) -> None:
-        """Idempotent graceful shutdown."""
+        """Idempotent graceful shutdown.  A borrowed shared loop stays
+        running for its owner."""
         try:
             self._lt.run(self._s.close())
         except ConnClosedError:
             return  # already closed
         finally:
-            self._lt.stop()
+            if self._owns_loop:
+                self._lt.stop()

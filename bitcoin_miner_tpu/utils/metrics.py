@@ -357,6 +357,13 @@ def format_quantiles(h) -> str:
 #:   ingress.conns_lost        conns the async ingress reaped after epoch loss
 #:   ingress.cross_thread_writes  off-loop writes hopped onto the ingress loop
 #:   gw.conns_live             live conns at the public serving transport (gauge)
+#:   fed.conns_live            live peer conns at the federation transport (gauge)
+#:   autoscale.scale_ups       worker spawn actions taken by the autoscaler
+#:   autoscale.scale_downs     clean-drain retire actions (incl. cell drains)
+#:   autoscale.actions_suppressed  ticks an action was wanted but held (hysteresis/cooldown)
+#:   autoscale.reweights       tenant WFQ weight override apply/restore actions
+#:   autoscale.actuator_failures   actuator calls that raised (queued for retry)
+#:   autoscale.target_workers  the controller's current worker target (gauge)
 #:   miner.nonces              nonces swept by this process's miner loop
 #:   miner.reconnects          successful re-Joins after a lost server conn
 #:   miner.tier_downgrades     kernel tiers abandoned by the sweep watchdog

@@ -333,8 +333,20 @@ class TelemetryHub:
         self._last_state: Optional[dict] = None  # guarded-by: _lock
         self._last_publish = 0.0  # guarded-by: _lock
         self._pub_id = 0  # subscriber-stream message ids  # guarded-by: _lock
+        #: Extra state providers (ISSUE 18): key -> zero-arg callable whose
+        #: dict return is published under ``state[key]`` each tick, exactly
+        #: like the SLO block — the autoscale controller's status() rides
+        #: this into the fleet log / dashboard.  # guarded-by: _lock
+        self._extras: dict = {}
         self._threads: list = []
         self._stop = threading.Event()
+
+    def add_extra(self, key: str, fn) -> None:
+        """Publish ``fn()`` (a JSON-able dict) under ``state[key]`` on
+        every tick.  Best-effort like every sink: a raising provider is
+        logged and retried next beat, never fatal to the tick."""
+        with self._lock:
+            self._extras[key] = fn
 
     def start(self, self_tick: Optional[float] = None) -> "TelemetryHub":
         t = threading.Thread(
@@ -390,6 +402,15 @@ class TelemetryHub:
             state["slo"] = self._slo.tick(
                 self.fleet, now=now, exclude=exclude, sources=sources,
             )
+        with self._lock:
+            extras = list(self._extras.items())
+        for key, fn in extras:
+            try:
+                state[key] = fn()
+            except Exception:
+                self._log.exception(
+                    "telemetry extra %r failed; will retry", key
+                )
         # Newly flagged stragglers get ONE trace event each (the fleet
         # event stream must not repeat the same verdict every tick).
         names = {s["source"] for s in strag}

@@ -25,6 +25,14 @@
 - ``sweep.fixture_refills`` is documented below but never emitted
   (``metric-unused`` — pins the ``sweep.*`` hot-plane counter family,
   which stays inc-kind, in the registry cross-check);
+- ``autoscale.target_workers`` is the capacity plane's fleet-size gauge
+  (the one gauge-kind name under ``autoscale.*``, ISSUE 18) but emitted
+  via ``inc`` (``metric-kind-mismatch``);
+- ``fed.conns_live`` is the federation transport's shared-loop conn
+  gauge (ISSUE 18) but emitted via ``inc`` (``metric-kind-mismatch``);
+- ``autoscale.fixture_actions`` is documented below but never emitted
+  (``metric-unused`` — pins the ``autoscale.*`` action-counter family,
+  which stays inc-kind, in the registry cross-check);
 - the computed-name ``inc`` cannot be registry-checked at all
   (``metric-dynamic-name``).
 """
@@ -51,6 +59,9 @@ class Metrics:  # stand-in so the fixture never imports the real package
 #:   ingress.fixture_events    an ingress counter, documented but never emitted
 #:   kernel.thresh_staleness   the hot plane's threshold-lag gauge (set_gauge-only kind)
 #:   sweep.fixture_refills     a hot-plane counter, documented but never emitted
+#:   autoscale.target_workers  the capacity plane's fleet-size gauge (set_gauge-only kind)
+#:   fed.conns_live            the federation shared-loop conn gauge (set_gauge-only kind)
+#:   autoscale.fixture_actions an autoscale action counter, documented but never emitted
 METRICS = Metrics()
 
 
@@ -61,4 +72,6 @@ def provoke_metric_drift(suffix: str) -> None:
     METRICS.inc("fed.peer_state.fixture")  # wrong emitter for a membership gauge
     METRICS.inc("gw.conns_live")  # wrong emitter for the ingress conn gauge
     METRICS.inc("kernel.thresh_staleness")  # wrong emitter for the lag gauge
+    METRICS.inc("autoscale.target_workers")  # wrong emitter for the fleet-size gauge
+    METRICS.inc("fed.conns_live")  # wrong emitter for the fed conn gauge
     METRICS.inc("fixture." + suffix)  # dynamic name: unverifiable

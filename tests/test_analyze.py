@@ -213,6 +213,19 @@ def test_metrics_rules_fire_on_fixture():
     assert ("metric-unused", "sweep.fixture_refills") in {
         (f.rule, f.symbol) for f in findings
     }
+    # autoscale.target_workers is the capacity plane's fleet-size gauge
+    # and fed.conns_live the federation transport's shared-loop conn
+    # gauge (ISSUE 18); the rest of autoscale.* counts controller
+    # actions and stays inc-kind, pinned by the unused-row cross-check.
+    assert ("metric-kind-mismatch", "autoscale.target_workers") in {
+        (f.rule, f.symbol) for f in findings
+    }
+    assert ("metric-kind-mismatch", "fed.conns_live") in {
+        (f.rule, f.symbol) for f in findings
+    }
+    assert ("metric-unused", "autoscale.fixture_actions") in {
+        (f.rule, f.symbol) for f in findings
+    }
 
 
 def test_metrics_pass_honors_metric_ok_declaration(tmp_path):

@@ -1,0 +1,410 @@
+"""Self-scaling capacity plane (ISSUE 18): deterministic policy suite.
+
+Every test drives :class:`AutoscaleController` with an INJECTED clock and
+synthetic evidence providers/actuators — no subprocesses, no wall-clock
+sleeps, no ports — so hold/cooldown/retry semantics are pinned exactly:
+
+- sustained burn scales up only after ``hold_ticks`` CONSECUTIVE ticks;
+  a flapping alert never moves capacity;
+- scale-down happens only via drain, held off by ``down_cooldown_s``
+  from the LAST action in either direction (never retire what you just
+  spawned);
+- tenant re-weighting fires before capacity moves and restores on
+  recovery;
+- a failed actuation retries next tick OUTSIDE the cooldown gate;
+- a cold-at-the-floor cell hands off once (axis b), never twice.
+
+The closed loop against real worker subprocesses is the bench's job
+(``tools/fleet_bench.py --autoscale``, test_fleet_bench's slow leg).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from bitcoin_miner_tpu.autoscale import (
+    AutoscaleConfig,
+    AutoscaleController,
+    CellActuator,
+    ControllerPump,
+    GatewayWeightActuator,
+    parse_autoscale_config,
+)
+from bitcoin_miner_tpu.utils.metrics import METRICS
+
+pytestmark = pytest.mark.autoscale
+
+
+# --------------------------------------------------------------- fakes
+
+
+class FakeWorkers:
+    """Axis-a actuator double: live count moves instantly on
+    spawn/drain (the real one's workers take time to exit — the policy
+    must not care)."""
+
+    def __init__(self, live: int = 1, fail_spawns: int = 0) -> None:
+        self._live = live
+        self.fail_spawns = fail_spawns
+        self.spawns: list = []
+        self.drains: list = []
+
+    def live(self) -> int:
+        return self._live
+
+    def spawn(self, n: int) -> None:
+        if self.fail_spawns > 0:
+            self.fail_spawns -= 1
+            raise OSError("exec failed")
+        self._live += n
+        self.spawns.append(n)
+
+    def drain(self, n: int) -> None:
+        self._live -= n
+        self.drains.append(n)
+
+
+class FakeWeights:
+    def __init__(self) -> None:
+        self.reweights: list = []
+        self.restores = 0
+
+    def reweight(self, weights: dict) -> None:
+        self.reweights.append(dict(weights))
+
+    def restore(self) -> None:
+        self.restores += 1
+
+
+class FakeCell:
+    def __init__(self) -> None:
+        self.drains = 0
+
+    def drain_cell(self) -> None:
+        self.drains += 1
+
+
+class Harness:
+    """One controller + mutable evidence + a hand-cranked clock."""
+
+    def __init__(self, live: int = 1, fail_spawns: int = 0,
+                 weights: bool = False, cell: bool = False,
+                 **cfg_kw) -> None:
+        self.now = 0.0
+        self.alerts: list = []
+        self.util: float | None = None
+        self.workers = FakeWorkers(live=live, fail_spawns=fail_spawns)
+        self.weights = FakeWeights() if weights else None
+        self.cell = FakeCell() if cell else None
+        self.ctl = AutoscaleController(
+            self.workers,
+            burn=lambda: self.alerts,
+            utilization=lambda: self.util,
+            weights=self.weights,
+            cell=self.cell,
+            config=AutoscaleConfig(**cfg_kw),
+            clock=lambda: self.now,
+        )
+
+    def tick(self, dt: float = 0.0) -> dict:
+        self.now += dt
+        return self.ctl.tick()
+
+
+def counter(name: str) -> float:
+    return METRICS.get(f"autoscale.{name}")
+
+
+# ------------------------------------------------------------ scale-up
+
+
+def test_scale_up_only_after_hold_ticks():
+    h = Harness(live=1, min_workers=1, max_workers=4, hold_ticks=3)
+    h.alerts = ["request_latency"]
+    sup0 = counter("actions_suppressed")
+    ups0 = counter("scale_ups")
+    d1 = h.tick()
+    d2 = h.tick()
+    assert not h.workers.spawns
+    assert (d1["suppressed"], d2["suppressed"]) == (True, True)
+    assert d2["state"] == "hold-up"
+    assert "hold-up 2/3" in d2["suppress_reason"]
+    assert counter("actions_suppressed") == sup0 + 2
+    d3 = h.tick()
+    assert d3["acted"] and h.workers.spawns == [1]
+    assert h.workers.live() == 2
+    assert d3["target"] == 2
+    assert counter("scale_ups") == ups0 + 1
+
+
+def test_alert_flap_never_moves_capacity():
+    h = Harness(live=1, hold_ticks=3)
+    for i in range(12):  # alert fires every other tick: streak never > 1
+        h.alerts = ["request_latency"] if i % 2 == 0 else []
+        h.util = 0.9  # busy: the quiet path stays out of the picture
+        h.tick()
+    assert not h.workers.spawns and not h.workers.drains
+
+
+def test_up_cooldown_blocks_back_to_back_spawns():
+    h = Harness(live=1, hold_ticks=1, up_cooldown_s=10.0, max_workers=4)
+    h.alerts = ["request_latency"]
+    h.tick()
+    assert h.workers.spawns == [1]
+    d = h.tick(dt=1.0)  # still burning, 1s after the spawn
+    assert d["suppressed"] and d["state"] == "cooldown-up"
+    assert "up-cooldown" in d["suppress_reason"]
+    assert h.workers.spawns == [1]
+    d = h.tick(dt=10.0)  # past the cooldown
+    assert h.workers.spawns == [1, 1]
+    assert d["target"] == 3
+
+
+def test_never_spawns_past_max_workers():
+    h = Harness(live=3, hold_ticks=1, max_workers=3)
+    h.alerts = ["request_latency"]
+    d = h.tick()
+    assert not h.workers.spawns
+    assert d["suppressed"] and "at-max" in d["suppress_reason"]
+
+
+# ---------------------------------------------------------- scale-down
+
+
+def test_scale_down_drains_after_hold_and_respects_down_cooldown():
+    h = Harness(live=3, min_workers=1, hold_ticks=2, down_cooldown_s=5.0)
+    h.util = 0.1
+    downs0 = counter("scale_downs")
+    d1 = h.tick()
+    assert d1["suppressed"] and d1["state"] == "hold-down"
+    d2 = h.tick()
+    assert h.workers.drains == [1] and h.workers.live() == 2
+    assert d2["target"] == 2
+    assert counter("scale_downs") == downs0 + 1
+    d3 = h.tick(dt=1.0)  # 1s after the drain: down-cooldown holds
+    assert d3["suppressed"] and d3["state"] == "cooldown-down"
+    assert h.workers.drains == [1]
+    h.tick(dt=6.0)  # past the cooldown: drains to the floor
+    assert h.workers.drains == [1, 1] and h.workers.live() == 1
+
+
+def test_no_drain_right_after_scale_up():
+    # The down-cooldown references the last action in EITHER direction:
+    # the controller must never retire the worker it just spawned.
+    h = Harness(live=1, hold_ticks=1, up_cooldown_s=0.0,
+                down_cooldown_s=100.0)
+    h.alerts = ["request_latency"]
+    h.tick()
+    assert h.workers.live() == 2
+    h.alerts = []
+    h.util = 0.0
+    d = h.tick(dt=1.0)
+    assert d["suppressed"] and d["state"] == "cooldown-down"
+    assert not h.workers.drains
+
+
+def test_unknown_utilization_never_scales_down():
+    h = Harness(live=3, hold_ticks=1)
+    h.util = None  # evidence unknown (stale fleet log, no gauge yet)
+    for _ in range(5):
+        h.tick(dt=1.0)
+    assert not h.workers.drains
+
+
+# ------------------------------------------------------------- weights
+
+
+def test_reweight_under_burn_then_restore_on_recovery():
+    h = Harness(live=1, weights=True, hold_ticks=1, max_workers=2,
+                up_cooldown_s=0.0,
+                overload_weights={"gold": 4.0, "free": 0.25})
+    rw0 = counter("reweights")
+    h.alerts = ["request_latency"]
+    d1 = h.tick()
+    # Axis c fires FIRST: paying traffic is protected before capacity
+    # moves (the spawn lands next tick).
+    assert h.weights.reweights == [{"gold": 4.0, "free": 0.25}]
+    assert not h.workers.spawns
+    assert d1["acted"] and counter("reweights") == rw0 + 1
+    assert h.ctl.status()["weights"] == {"gold": 4.0, "free": 0.25}
+    h.tick()
+    assert h.workers.spawns == [1]
+    h.alerts = []
+    h.util = 0.9  # recovered but busy: restore is independent of drains
+    h.tick()
+    assert h.weights.restores == 1
+    assert h.ctl.status()["weights"] == {}
+    assert not h.workers.drains
+
+
+# --------------------------------------------------------------- retry
+
+
+def test_failed_spawn_retries_next_tick_outside_cooldown():
+    h = Harness(live=1, fail_spawns=1, hold_ticks=1, up_cooldown_s=100.0)
+    f0 = counter("actuator_failures")
+    h.alerts = ["request_latency"]
+    d1 = h.tick()
+    assert counter("actuator_failures") == f0 + 1
+    assert "FAILED" in d1["last_action"]
+    assert h.ctl.status()["pending"] == "spawn"
+    # Next tick retries the queued spawn FIRST — the 100s up-cooldown
+    # must not stretch a transient exec failure into lost capacity.
+    d2 = h.tick(dt=1.0)
+    assert h.workers.spawns == [1] and h.workers.live() == 2
+    assert d2["last_action"] == "spawn 1"
+    assert h.ctl.status()["pending"] is None
+
+
+# -------------------------------------------------------------- axis b
+
+
+def test_cold_cell_hands_off_once():
+    h = Harness(live=1, cell=True, min_workers=1, hold_ticks=1,
+                cell_drain_ticks=2)
+    h.util = 0.0
+    h.tick()
+    assert h.cell.drains == 0
+    d = h.tick()
+    assert h.cell.drains == 1 and d["state"] == "cell-drained"
+    for _ in range(3):  # still cold: the handoff never repeats
+        d = h.tick(dt=1.0)
+    assert h.cell.drains == 1 and d["state"] == "cell-drained"
+
+
+def test_cell_actuator_forwards_reason_and_latch():
+    class Rep:
+        def __init__(self):
+            self.reasons = []
+
+        def drain(self, reason="drain"):
+            self.reasons.append(reason)
+
+    fired = []
+    rep = Rep()
+    CellActuator(rep, reason="autoscale",
+                 on_drained=lambda: fired.append(True)).drain_cell()
+    assert rep.reasons == ["autoscale"] and fired == [True]
+
+
+# ------------------------------------------------------------ plumbing
+
+
+def test_target_workers_gauge_tracks_target():
+    h = Harness(live=2, hold_ticks=1, max_workers=4)
+    h.alerts = ["request_latency"]
+    h.tick()
+    assert METRICS.gauges()["autoscale.target_workers"] == 3.0
+
+
+def test_settles_back_to_steady():
+    h = Harness(live=1, hold_ticks=1, max_workers=2)
+    h.alerts = ["request_latency"]
+    h.tick()
+    h.alerts = []
+    h.util = 0.9  # in band: no action, no suppression
+    d = h.tick(dt=1.0)
+    assert d["state"] == "steady" and not d["acted"] and not d["suppressed"]
+
+
+def test_gateway_weight_actuator_holds_the_lock():
+    import threading
+
+    class GW:
+        def __init__(self):
+            self.sets = []
+            self.cleared = 0
+
+        def set_tenant_weights(self, w):
+            self.sets.append(w)
+
+        def clear_tenant_weights(self):
+            self.cleared += 1
+
+    gw, lock = GW(), threading.Lock()
+    act = GatewayWeightActuator(gw, lock)
+    act.reweight({"gold": 4.0})
+    act.restore()
+    assert gw.sets == [{"gold": 4.0}] and gw.cleared == 1
+
+
+def test_gateway_tenant_weight_overrides():
+    from bitcoin_miner_tpu.apps.scheduler import Scheduler
+    from bitcoin_miner_tpu.gateway import Gateway, ResultCache, SpanStore
+
+    gw = Gateway(Scheduler(), cache=ResultCache(), spans=SpanStore())
+    assert gw._weight_of("anyone") == 1.0
+    gw.set_tenant_weights({"gold": 4.0, "free": 0.25, "bogus": 0.0})
+    assert gw.tenant_weights() == {"gold": 4.0, "free": 0.25}  # 0 dropped
+    assert gw._weight_of("gold") == 4.0
+    assert gw._weight_of("unlisted") == 1.0
+    gw.clear_tenant_weights()
+    assert gw.tenant_weights() == {} and gw._weight_of("gold") == 1.0
+
+
+def test_controller_pump_drives_ticks_and_stops():
+    import threading
+
+    done = threading.Event()
+
+    class Ctl:
+        def __init__(self):
+            self.ticks = 0
+
+        def tick(self, now=None):
+            self.ticks += 1
+            if self.ticks >= 3:
+                done.set()
+
+    ctl = Ctl()
+    pump = ControllerPump(ctl, interval=0.01).start()
+    assert done.wait(5.0)
+    pump.stop()
+    assert ctl.ticks >= 3
+
+
+# ------------------------------------------------------------ the spec
+
+
+def test_parse_autoscale_config_full_grammar():
+    cfg, driver = parse_autoscale_config(
+        "min=1,max=3,step=2,hold=2,up_cooldown=4,down_cooldown=6,"
+        "util_low=0.4,cell_drain=5,interval=0.5,backend=xla,"
+        "weights=gold:4;free:0.25"
+    )
+    assert cfg == AutoscaleConfig(
+        min_workers=1, max_workers=3, step=2, hold_ticks=2,
+        up_cooldown_s=4.0, down_cooldown_s=6.0, util_low=0.4,
+        overload_weights={"gold": 4.0, "free": 0.25}, cell_drain_ticks=5,
+    )
+    assert driver == {"interval": 0.5, "backend": "xla"}
+
+
+def test_parse_autoscale_config_defaults_and_errors():
+    assert parse_autoscale_config("1")[0] == AutoscaleConfig()
+    for bad in ("mni=2", "min=3,max=1", "min", "hold=0", "weights=gold",
+                "min=x"):
+        with pytest.raises(ValueError):
+            parse_autoscale_config(bad)
+
+
+def test_fleet_log_evidence_tails_and_goes_stale(tmp_path):
+    from tools.autoscale import _FleetLogEvidence
+
+    path = tmp_path / "fleet.jsonl"
+    now = [0.0]
+    ev = _FleetLogEvidence(str(path), stale_after=5.0, clock=lambda: now[0])
+    ev.poll()  # file does not exist yet: evidence stays unknown
+    assert ev.alerts() is None and ev.utilization() is None
+    row = {"slo": {"alerts": ["request_latency"]},
+           "gauges": {"fleet.utilization": 0.75}}
+    with open(path, "w") as f:
+        f.write(json.dumps(row) + "\n")
+        f.write('{"torn')  # concurrent append: must be skipped, not crash
+    ev.poll()
+    assert ev.alerts() == ["request_latency"]
+    assert ev.utilization() == 0.75
+    now[0] = 6.0  # no new row within stale_after: evidence parks
+    assert ev.alerts() is None and ev.utilization() is None

@@ -1097,3 +1097,63 @@ def test_forward_propagates_originating_admission_identity():
         assert "fed:peer" not in keys
     finally:
         fleet.close()
+
+
+# ------------------- peer-transport thread scaling (ISSUE 15 -> ISSUE 18)
+
+
+@pytest.mark.autoscale
+def test_fed_plane_threads_flat_as_peers_grow():
+    """The ISSUE 18 prerequisite refactor: a cell's fed port, forwarder
+    conns AND gossip clients all ride ONE shared loop, so its thread
+    count is O(1) in peers.  Per-cell thread cost at mesh sizes 2 and 4
+    must be EQUAL — peer growth shows up as CONNS on the shared loop
+    (the ``fed_conns`` stat / ``fed.conns_live`` gauge), never as
+    threads.  The PR-15 flat-threads proof (test_ingress) pins the
+    public side; this pins the peer side."""
+
+    def per_cell(n):
+        # Let stragglers from earlier tests/fleets die before baselining.
+        base = threading.active_count()
+        _wait(lambda: threading.active_count() <= base, timeout=2.0)
+        before = threading.active_count()
+        fleet = FedFleet(n=n, miners=0, gossip_interval=0.05)
+        try:
+            # Every cell's fed server must hold a live conn FROM each
+            # peer's gossip client before counting — those conns are
+            # exactly what cost a loop thread apiece before the refactor.
+            assert _wait(lambda: all(
+                rep.fed.conns_live() >= n - 1
+                for rep in fleet.replicas.values()
+            )), {nm: rep.fed.conns_live() for nm, rep in fleet.replicas.items()}
+            conns = sum(r.fed.conns_live() for r in fleet.replicas.values())
+            threads = threading.active_count() - before
+        finally:
+            fleet.close()
+        assert threads % n == 0, (threads, n)
+        return threads // n, conns
+
+    t2, conns2 = per_cell(2)
+    t4, conns4 = per_cell(4)
+    assert t4 == t2, (t2, t4)
+    assert conns4 > conns2  # the growth landed on conns, not threads
+
+
+@pytest.mark.autoscale
+def test_fed_conns_live_gauge_published_by_ticker():
+    """The thread-accounting satellite: the serve ticker publishes the
+    fed transport's live-conn count as the ``fed.conns_live`` gauge (the
+    federation spelling of ``gw.conns_live``), and the replica's stats
+    carry ``fed_conns`` so the health line shows it."""
+    METRICS.reset()
+    fleet = FedFleet(n=2, gossip_interval=0.05)
+    try:
+        assert _wait(
+            lambda: METRICS.gauges().get("fed.conns_live", 0.0) >= 1.0
+        ), METRICS.gauges()
+        rep = fleet.replicas["r0"]
+        with rep.lock:
+            st = rep.router.stats()
+        assert st["fed_conns"] == rep.fed.conns_live()
+    finally:
+        fleet.close()

@@ -64,6 +64,32 @@ def test_fleet_bench_smoke_cpu():
 
 
 @pytest.mark.slow
+@pytest.mark.autoscale
+def test_fleet_bench_autoscale_leg():
+    """The --autoscale leg end-to-end at reduced scale: the tool itself
+    raises if any link of the causal chain breaks (alert fires, scale-up
+    within discipline, p99 recovers, clean drain to the floor, bit-exact
+    answers), so rc 0 + the JSON shape IS the assertion."""
+    p = _run_fleet(
+        ["--autoscale", "--as-warm-s", "4", "--as-overload-s", "14",
+         "--as-deadline", "90"],
+        timeout=360,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "autoscale_p99_speedup"
+    assert out["value"] > 1.0
+    auto = out["autoscaled"]
+    assert auto["alerts_fired"] == ["request-p95"]
+    assert auto["scale_ups"] >= 1 and auto["scale_downs"] >= 1
+    assert auto["end_live"] == 1
+    assert auto["drained_exit_codes"] and all(
+        c == 0 for c in auto["drained_exit_codes"]
+    )
+    assert auto["workers_peak"] > out["fixed"]["workers_peak"]
+
+
+@pytest.mark.slow
 def test_fleet_bench_kill_drill_cpu():
     # Drill sized to ~6 s of clean sweep on this host, so the SIGKILL
     # (kill_at >= 1 s) provably fires mid-job even on a fast CI box —

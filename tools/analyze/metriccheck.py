@@ -64,16 +64,21 @@ def _name_kind(name: str) -> str:
         return "hist"
     if name.startswith(
         (
-            "gauge.", "fleet.", "fed.peer_state", "gw.conns_live",
-            "kernel.thresh_staleness",
+            "gauge.", "fleet.", "fed.peer_state", "fed.conns_live",
+            "gw.conns_live", "kernel.thresh_staleness",
+            "autoscale.target_workers",
         )
     ):
         # fed.peer_state[.<peer>] is the per-peer membership gauge family
-        # (ISSUE 12); the rest of fed.* stays counter-kind.  gw.conns_live
-        # is the ingress live-conn gauge (ISSUE 15) — the only gauge-kind
-        # name under gw.*.  kernel.thresh_staleness is the hot plane's
-        # sieve-threshold lag level (ISSUE 16) — the one gauge-kind name
-        # under kernel.*, while sweep.* stays counter-kind.
+        # (ISSUE 12) and fed.conns_live the federation transport's
+        # live-conn level (ISSUE 18); the rest of fed.* stays
+        # counter-kind.  gw.conns_live is the ingress live-conn gauge
+        # (ISSUE 15) — the only gauge-kind name under gw.*.
+        # kernel.thresh_staleness is the hot plane's sieve-threshold lag
+        # level (ISSUE 16) — the one gauge-kind name under kernel.*,
+        # while sweep.* stays counter-kind.  autoscale.target_workers is
+        # the controller's worker-target level (ISSUE 18); the other
+        # autoscale.* names count actions and stay counters.
         return "gauge"
     return "counter"
 

@@ -39,6 +39,11 @@ EXTERNAL_CLASSES = (
     ("bitcoin_miner_tpu/workloads/base.py", "Workload"),
     ("bitcoin_miner_tpu/workloads/sha256.py", "Sha256Workload"),
     ("bitcoin_miner_tpu/workloads/blake2b.py", "Blake2bWorkload"),
+    # The autoscale CONTROLLER is pure policy serialized by its driver
+    # (ControllerPump's single thread, or a test's hand crank); the
+    # thread lives in autoscale/actuator.ControllerPump, deliberately
+    # outside this class (ISSUE 18).
+    ("bitcoin_miner_tpu/autoscale/controller.py", "AutoscaleController"),
 )
 
 #: Internally-locked classes expected to carry ``# guarded-by:`` field

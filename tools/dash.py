@@ -42,7 +42,7 @@ from bitcoin_miner_tpu.utils.metrics import format_quantiles  # noqa: E402
 #: Counters worth a dashboard row even when many exist (prefix order =
 #: display order); everything else folds into the "other" count.
 _COUNTER_PREFIXES = ("sched.", "gateway.", "miner.", "telemetry.", "slo.",
-                     "federation.", "fed.", "gossip.")
+                     "federation.", "fed.", "gossip.", "autoscale.")
 
 #: fed.peer_state gauge codes (ISSUE 12) rendered human-readable.
 _PEER_STATES = ("OK", "SHEDDING", "DRAINING", "SUSPECT", "DEAD")
@@ -78,6 +78,29 @@ def render_frame(state: dict, width: int = 78) -> str:
             lines.append(
                 f"  {s['name']:<20} {s['burn_fast']:>8.2f}/{s['burn_slow']:<8.2f} {mark}"
             )
+    autoscale = state.get("autoscale")
+    if autoscale:
+        # The controller's own status() (hub extra, ISSUE 18) next to the
+        # ticker's gauges: target vs live is the loop's error signal.
+        gauges = state.get("gauges") or {}
+        target = autoscale.get("target", gauges.get("autoscale.target_workers"))
+        live = gauges.get("gauge.miners_live")
+        lines.append(bar)
+        lines.append(
+            f"autoscale: {autoscale.get('state', '?'):<14} "
+            f"target={target} live={int(live) if live is not None else '?'}"
+        )
+        if autoscale.get("last_action"):
+            lines.append(f"  last action: {autoscale['last_action']}")
+        if autoscale.get("suppress_reason"):
+            lines.append(f"  suppressed:  {autoscale['suppress_reason']}")
+        if autoscale.get("pending"):
+            lines.append(f"  pending:     {autoscale['pending']}")
+        weights = autoscale.get("weights")
+        if weights:
+            shown_w = " ".join(
+                f"{t}={w:g}" for t, w in sorted(weights.items()))
+            lines.append(f"  tenant weights (overload): {shown_w}")
     peer_states = {
         k[len("fed.peer_state."):]: v
         for k, v in (state.get("gauges") or {}).items()

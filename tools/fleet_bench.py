@@ -1120,6 +1120,323 @@ def run_depth_compare(args) -> int:
     return 0
 
 
+def run_autoscale_bench(args) -> int:
+    """The self-scaling capacity plane leg (ISSUE 18): the SAME seeded
+    open-loop Poisson arrival schedule — a warm phase, then a ramp past
+    one worker's capacity — against a fixed 1-worker fleet and against
+    the autoscale controller closing the loop from SLO burn alerts to
+    worker spawns.  Asserts the whole causal chain on the autoscaled
+    leg: the request-latency burn alert FIRES, the controller SCALES UP
+    (within its hold/cooldown discipline), p99 recovers vs the fixed
+    leg, and after the ramp the controller CLEAN-DRAINS back to the
+    floor — every retired worker exits 0 (SIGTERM drain, not SIGKILL)
+    and every answer is bit-exact on the oracle.
+
+    Workers are real ``apps.miner`` subprocesses spawned/retired by the
+    controller's own :class:`ProcessActuator`, throttled to
+    ``--as-throttle-nps`` (BMT_MINER_THROTTLE_NPS) so each worker is one
+    deterministic unit of capacity — the box has one core, so UNPACED
+    cpu workers would all share it and scale-up would add no throughput;
+    the pace is stamped into the JSON line (same honesty contract as the
+    dispatch leg's induced straggler).  Prints one JSON line (the
+    BENCH_pr18 artifact)."""
+    import random
+    import threading
+
+    from bitcoin_miner_tpu import lsp
+    from bitcoin_miner_tpu.apps import client as client_mod
+    from bitcoin_miner_tpu.apps import server as server_mod
+    from bitcoin_miner_tpu.apps.scheduler import Scheduler
+    from bitcoin_miner_tpu.autoscale import (
+        AutoscaleConfig, AutoscaleController, ControllerPump,
+        ProcessActuator,
+    )
+    from bitcoin_miner_tpu.gateway import Gateway, SpanStore
+    from bitcoin_miner_tpu.utils import sanitize
+    from bitcoin_miner_tpu.utils.metrics import METRICS
+    from bitcoin_miner_tpu.utils.slo import SloEngine, default_slos
+    from bitcoin_miner_tpu.utils.telemetry import TelemetryHub
+
+    min_hash_range = WORKLOAD.min_range
+    # Miner-binary default params: the workers are REAL subprocesses the
+    # actuator spawns with the frozen CLI, so the in-process server must
+    # speak the params they default to.
+    params = lsp.Params()
+    nonces = args.as_nonces
+    throttle = args.as_throttle_nps
+    service_s = nonces / throttle  # one job, one worker, no queue
+    slo_threshold_s = args.as_slo_threshold_s or round(1.5 * service_s, 3)
+
+    # ONE seeded arrival schedule, shared by both legs: open-loop Poisson
+    # at warm_x of one worker's capacity for warm_s seconds, then
+    # overload_x (past one worker, under max_workers) for overload_s.
+    rng = random.Random(args.as_seed)
+    arrivals: list = []
+    t = 0.0
+    for rate_x, until in (
+        (args.as_warm_x, args.as_warm_s),
+        (args.as_overload_x, args.as_warm_s + args.as_overload_s),
+    ):
+        lam = rate_x * throttle / nonces  # jobs/s
+        while True:
+            t += rng.expovariate(lam)
+            if t >= until:
+                t = until  # phase boundary: unused tail draw
+                break
+            arrivals.append(t)
+    if len(arrivals) < 4:
+        raise RuntimeError(f"degenerate schedule: {len(arrivals)} arrivals")
+
+    def _pct(xs: list, q: float):
+        if not xs:
+            return None
+        s = sorted(xs)
+        return round(s[min(len(s) - 1, int(round(q * (len(s) - 1))))], 3)
+
+    tmp = tempfile.mkdtemp(prefix="autoscale_bench_")
+
+    def leg(tag: str, autoscaled: bool) -> dict:
+        METRICS.reset()
+        server = lsp.Server(0, params, label="server")
+        # Chunks a few tenths long so one queued job range-splits across
+        # every live worker — scale-up must shorten the in-flight job's
+        # tail, not just drain the backlog behind it.
+        sched = Scheduler(
+            min_chunk=5_000, max_chunk=nonces, target_chunk_seconds=0.4,
+        )
+        gw = Gateway(sched, rate=None, spans=SpanStore())
+        lock = sanitize.make_lock(f"autoscale-bench.{tag}")
+        # Burn evidence: the serve ticker drives the hub each beat; the
+        # gateway observes hist.request_s in-process, so the request-p95
+        # SLO needs no miner exporters.  Windows sized to the leg (6/15 s)
+        # with a low burn threshold: the ramp must fire the alert in
+        # seconds, not the production default's minutes.
+        slo = SloEngine([
+            s for s in default_slos(
+                request_threshold_s=slo_threshold_s, objective=0.9,
+                fast_window_s=6.0, slow_window_s=15.0,
+                burn_threshold=2.0, min_events=3,
+            ) if s.name == "request-p95"
+        ])
+        hub = TelemetryHub(0, params=params, slo=slo,
+                           publish_interval=0.5).start()
+        threading.Thread(
+            target=server_mod.serve,
+            args=(server, gw),
+            kwargs={"tick_interval": 0.1, "lock": lock, "telemetry": hub},
+            daemon=True,
+        ).start()
+        workers = ProcessActuator(
+            server.port, backend="cpu", log_dir=tmp,
+            extra_env={"BMT_MINER_THROTTLE_NPS": str(throttle)},
+        )
+        pump = None
+        alerts_seen: set = set()
+        timeline: list = []
+        mon_stop = threading.Event()
+        try:
+            workers.spawn(1)  # the floor worker both legs start from
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                with lock:
+                    if gw.stats()["miners"] >= 1:
+                        break
+                time.sleep(0.05)
+            else:
+                raise RuntimeError(f"{tag}: floor worker never joined")
+
+            controller = None
+            if autoscaled:
+                cfg = AutoscaleConfig(
+                    min_workers=1, max_workers=args.as_max_workers, step=1,
+                    hold_ticks=args.as_hold,
+                    up_cooldown_s=args.as_up_cooldown,
+                    down_cooldown_s=args.as_down_cooldown, util_low=0.5,
+                )
+
+                def burn():
+                    st = hub.last_state() or {}
+                    alerts = (st.get("slo") or {}).get("alerts") or None
+                    if alerts:
+                        alerts_seen.update(alerts)
+                    return alerts
+
+                controller = AutoscaleController(
+                    workers, burn=burn,
+                    utilization=lambda: METRICS.gauges().get(
+                        "fleet.utilization"),
+                    config=cfg,
+                )
+                pump = ControllerPump(
+                    controller, interval=args.as_interval).start()
+
+            t0 = time.monotonic()
+
+            def monitor() -> None:
+                while not mon_stop.wait(1.0):
+                    row = {
+                        "t": round(time.monotonic() - t0, 1),
+                        "live": workers.live(),
+                    }
+                    if controller is not None:
+                        st = controller.status()
+                        row["state"] = st["state"]
+                        row["target"] = st["target"]
+                    timeline.append(row)
+
+            threading.Thread(target=monitor, daemon=True).start()
+
+            latencies: dict = {}
+            results: dict = {}
+            rec = threading.Lock()
+
+            def fire(i: int, t_arr: float) -> None:
+                delay = t0 + t_arr - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                data = f"as-{tag}-{i}"
+                start = time.monotonic()
+                got = client_mod.request_with_retry(
+                    "127.0.0.1", server.port, data, nonces - 1,
+                    retries=8, backoff_base=0.1, params=params,
+                    label=f"client-{tag}-{i}",
+                )
+                with rec:
+                    latencies[i] = time.monotonic() - start
+                    results[i] = got
+
+            clients = [
+                threading.Thread(target=fire, args=(i, t_arr), daemon=True)
+                for i, t_arr in enumerate(arrivals)
+            ]
+            for c in clients:
+                c.start()
+            batch_deadline = t0 + args.as_deadline
+            for c in clients:
+                c.join(timeout=max(0.1, batch_deadline - time.monotonic()))
+            if any(c.is_alive() for c in clients):
+                raise RuntimeError(f"{tag}: batch exceeded "
+                                   f"{args.as_deadline}s open-loop deadline")
+            wall = time.monotonic() - t0
+            for i in range(len(arrivals)):
+                want = min_hash_range(f"as-{tag}-{i}", 0, nonces - 1)
+                if results.get(i) != want:
+                    raise RuntimeError(
+                        f"{tag}: job {i} got {results.get(i)}, want {want}")
+
+            out = {
+                "wall_s": round(wall, 3),
+                "jobs": len(arrivals),
+                "p50_s": _pct(list(latencies.values()), 0.50),
+                "p95_s": _pct(list(latencies.values()), 0.95),
+                "p99_s": _pct(list(latencies.values()), 0.99),
+                "workers_peak": max(
+                    (r["live"] for r in timeline), default=1),
+            }
+            if autoscaled:
+                # The ramp is over: the controller must now walk the
+                # fleet back down to the floor through clean drains.
+                drain_deadline = time.monotonic() + (
+                    args.as_max_workers
+                    * (args.as_down_cooldown + args.as_interval * args.as_hold)
+                    + 30.0
+                )
+                while time.monotonic() < drain_deadline:
+                    # live() drops at SIGTERM; the None codes clear when
+                    # the drained workers finish their in-flight chunks
+                    # and actually exit — wait for both.
+                    if (workers.live() == 1
+                            and None not in workers.exit_codes()):
+                        break
+                    time.sleep(0.2)
+                else:
+                    raise RuntimeError(
+                        f"{tag}: never drained back to the floor "
+                        f"(live={workers.live()}, "
+                        f"codes={workers.exit_codes()})")
+                codes = workers.exit_codes()
+                if any(c != 0 for c in codes):
+                    raise RuntimeError(
+                        f"{tag}: non-clean worker exits {codes} "
+                        "(0 = SIGTERM drain finished its chunks)")
+                if not alerts_seen:
+                    raise RuntimeError(f"{tag}: burn alert never fired")
+                scale_ups = METRICS.get("autoscale.scale_ups")
+                scale_downs = METRICS.get("autoscale.scale_downs")
+                if not scale_ups or not scale_downs:
+                    raise RuntimeError(
+                        f"{tag}: controller never closed the loop "
+                        f"(ups={scale_ups} downs={scale_downs})")
+                out.update({
+                    "alerts_fired": sorted(alerts_seen),
+                    "scale_ups": scale_ups,
+                    "scale_downs": scale_downs,
+                    "actions_suppressed": METRICS.get(
+                        "autoscale.actions_suppressed"),
+                    "reweights": METRICS.get("autoscale.reweights"),
+                    "actuator_failures": METRICS.get(
+                        "autoscale.actuator_failures"),
+                    "drained_exit_codes": codes,
+                    "end_live": workers.live(),
+                    "timeline": timeline,
+                })
+            return out
+        finally:
+            mon_stop.set()
+            if pump is not None:
+                pump.stop()
+            workers.stop_all()
+            hub.close()
+            server.close()
+
+    fixed = leg("fixed", autoscaled=False)
+    autoscaled = leg("auto", autoscaled=True)
+    if autoscaled["p99_s"] >= fixed["p99_s"]:
+        raise RuntimeError(
+            f"autoscaling did not recover p99: {autoscaled['p99_s']}s vs "
+            f"fixed {fixed['p99_s']}s")
+    speedup = round(fixed["p99_s"] / autoscaled["p99_s"], 3)
+    log(f"fixed:      {fixed}")
+    log(f"autoscaled: {autoscaled}")
+    log(f"p99 speedup: {speedup}x")
+    print(
+        json.dumps(
+            {
+                "metric": "autoscale_p99_speedup",
+                "value": speedup,
+                "unit": "x p99 latency vs fixed 1-worker fleet, same "
+                        "seeded arrival schedule",
+                "workload": WORKLOAD.name,
+                "job_nonces": nonces,
+                "worker_throttle_nps": throttle,
+                "slo_threshold_s": slo_threshold_s,
+                "schedule": {
+                    "seed": args.as_seed,
+                    "warm_s": args.as_warm_s,
+                    "warm_x": args.as_warm_x,
+                    "overload_s": args.as_overload_s,
+                    "overload_x": args.as_overload_x,
+                    "arrivals": len(arrivals),
+                },
+                "controller": {
+                    "min_workers": 1,
+                    "max_workers": args.as_max_workers,
+                    "step": 1,
+                    "hold_ticks": args.as_hold,
+                    "up_cooldown_s": args.as_up_cooldown,
+                    "down_cooldown_s": args.as_down_cooldown,
+                    "util_low": 0.5,
+                    "interval_s": args.as_interval,
+                },
+                "fixed": fixed,
+                "autoscaled": autoscaled,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nonces", type=int, default=2 * 10**10)
@@ -1251,6 +1568,47 @@ def main() -> int:
     ap.add_argument("--dp-clients", type=int, default=2)
     ap.add_argument("--dp-deadline", type=float, default=300.0)
     ap.add_argument(
+        "--autoscale",
+        action="store_true",
+        help="self-scaling capacity plane leg (ISSUE 18): the same seeded "
+        "open-loop arrival ramp against a fixed 1-worker fleet and "
+        "against the SLO-burn-driven controller (spawn on burn, clean "
+        "drain after); asserts alert->scale-up->p99-recovery->drain and "
+        "bit-exact answers; prints its own JSON line and exits",
+    )
+    ap.add_argument("--as-nonces", type=int, default=40_000,
+                    help="nonces per autoscale-leg job (short jobs: burn "
+                    "evidence arrives at completion, so the completion "
+                    "rate is the controller's evidence rate)")
+    ap.add_argument("--as-throttle-nps", type=float, default=50_000.0,
+                    help="per-worker pace (BMT_MINER_THROTTLE_NPS): one "
+                    "deterministic unit of capacity per worker on a "
+                    "1-core box")
+    ap.add_argument("--as-warm-s", type=float, default=6.0,
+                    help="seconds of in-capacity warm arrivals")
+    ap.add_argument("--as-warm-x", type=float, default=0.25,
+                    help="warm arrival rate as a multiple of one "
+                    "worker's capacity")
+    ap.add_argument("--as-overload-s", type=float, default=20.0,
+                    help="seconds of past-capacity ramp arrivals")
+    ap.add_argument("--as-overload-x", type=float, default=2.2,
+                    help="ramp arrival rate as a multiple of one worker's "
+                    "capacity (must exceed 1, stay under --as-max-workers)")
+    ap.add_argument("--as-max-workers", type=int, default=3)
+    ap.add_argument("--as-interval", type=float, default=0.25,
+                    help="controller tick interval (s)")
+    ap.add_argument("--as-hold", type=int, default=3,
+                    help="consecutive burning/quiet ticks before acting")
+    ap.add_argument("--as-up-cooldown", type=float, default=3.0)
+    ap.add_argument("--as-down-cooldown", type=float, default=6.0)
+    ap.add_argument("--as-slo-threshold-s", type=float, default=None,
+                    help="request-p95 SLO latency threshold "
+                    "(default: 1.5x one job's unqueued service time)")
+    ap.add_argument("--as-deadline", type=float, default=120.0,
+                    help="open-loop batch deadline per leg (s)")
+    ap.add_argument("--as-seed", type=int, default=1,
+                    help="arrival-schedule seed (both legs share it)")
+    ap.add_argument(
         "--federation",
         type=int,
         default=0,
@@ -1287,6 +1645,9 @@ def main() -> int:
 
     if args.depth_compare:
         return run_depth_compare(args)
+
+    if args.autoscale:
+        return run_autoscale_bench(args)
 
     if args.federation:
         return run_federation_bench(args)

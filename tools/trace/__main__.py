@@ -98,9 +98,12 @@ def main(argv: List[str] | None = None) -> int:
               f"{sorted(report.orphans)}")
     for key, n in sorted(report.fleet_summary().items()):
         print(f"fleet: {key} ×{n}")
-    # The WHYs, verbatim, for the abandonment events a soak cares about.
+    # The WHYs, verbatim, for the abandonment events a soak cares about —
+    # and the capacity plane's decision -> action -> settled timeline
+    # (ISSUE 18), so an autoscale drill's trace reads as a story.
     for e in report.fleet:
-        if e.get("event") in ("tier_downgrade", "wedge_detected", "gave_up"):
+        if (e.get("event") in ("tier_downgrade", "wedge_detected", "gave_up")
+                or e.get("span") == "autoscale"):
             print(f"fleet detail: t={e['t']:.3f} {e['span']}.{e['event']} "
                   f"{e.get('attrs', {})}")
     return 1 if (args.strict and bad) else 0
