@@ -519,7 +519,9 @@ class _TieredSearch:
         self._jobs: "_queue.Queue" = _queue.Queue()
         self._thread = threading.Thread(
             target=self._loop, name="tiered-search", daemon=True
-        )
+        )  # thread-owner: process — close() must NOT block behind a
+        # wedged tier's in-flight job; the daemon drains the shutdown
+        # sentinel when the tier unwedges, or dies with the process
         self._thread.start()
 
     def submit(self, data: str, lower: int, upper: int):

@@ -510,7 +510,7 @@ class _LoopBridge:
     resource graph catches in the sync facades)."""
 
     def __init__(self, server: "lsp.AsyncServer", loop) -> None:
-        self._server = server
+        self._server = server  # on-loop: _loop — writers must hop (loopcheck)
         self._loop = loop
         self._thread = threading.current_thread()  # the ingress loop thread
 
@@ -526,7 +526,7 @@ class _LoopBridge:
             # write-after-close (callers catch LspError).
             raise lsp.ConnClosedError() from None
 
-    def _write_on_loop(self, conn_id: int, payload: bytes) -> None:
+    def _write_on_loop(self, conn_id: int, payload: bytes) -> None:  # on-loop:
         try:
             self._server.write(conn_id, payload)
         except lsp.LspError:
@@ -541,7 +541,7 @@ class _LoopBridge:
         except RuntimeError:
             raise lsp.ConnClosedError() from None
 
-    def _close_on_loop(self, conn_id: int) -> None:
+    def _close_on_loop(self, conn_id: int) -> None:  # on-loop:
         try:
             self._server.close_conn(conn_id)
         except lsp.LspError:
@@ -550,12 +550,12 @@ class _LoopBridge:
     def peer_host(self, conn_id: int) -> Optional[str]:
         # Handler context only (the plane resolves identities before it
         # takes the event lock, ON the loop thread).
-        return self._server.peer_host(conn_id)
+        return self._server.peer_host(conn_id)  # loop-ok: handler context
 
     def conns_live(self) -> int:
         # len() of the conn dict is one atomic bytecode under the GIL: a
         # benign snapshot read from the ticker thread, not worth a hop.
-        return self._server.conns_live()
+        return self._server.conns_live()  # loop-ok: GIL-atomic snapshot
 
 
 class AsyncIngress:

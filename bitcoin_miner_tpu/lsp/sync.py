@@ -94,13 +94,17 @@ class _LoopThread:
 
     def _observe_entry(self, what: str) -> None:
         """Sanitizer coverage for a blocking proxy call (see class
-        docstring): refuse self-deadlocks, record lock-order edges."""
+        docstring): refuse self-deadlocks, refuse blocking any OTHER
+        registered loop (ISSUE 19 — a loop thread parked on a Future
+        stalls every conn riding it, whichever loop resolves it), and
+        record lock-order edges."""
         if threading.current_thread() is self._thread:
             raise sanitize.RaceError(
                 f"{self._san_name}.{what}() called from its own loop "
                 f"thread — the blocking Future can never resolve while "
                 f"the loop waits on it (guaranteed deadlock)"
             )
+        sanitize.blocking(f"{self._san_name}.{what}")
         sanitize.loop_wait(self._san_name)
 
     def call(self, fn: Callable, *args: Any) -> Any:
@@ -110,7 +114,7 @@ class _LoopThread:
             self._observe_entry("call")
         done: Future = Future()
 
-        def _invoke() -> None:
+        def _invoke() -> None:  # on-loop: runs via call_soon_threadsafe
             try:
                 done.set_result(fn(*args))
             except BaseException as e:  # propagate to caller

@@ -1086,9 +1086,13 @@ class _HotLoop:
         if self._carry is None:
             return None
         if self._mesh is not None:
-            bh0, bh1, bseq, bdev, bflat = (int(x) for x in self._carry)
+            bh0, bh1, bseq, bdev, bflat = (
+                int(x) for x in self._carry
+            )  # donate-ok: THE job-end fetch — the one sanctioned sync
         else:
-            bh0, bh1, bseq, bflat = (int(x) for x in self._carry)
+            bh0, bh1, bseq, bflat = (
+                int(x) for x in self._carry
+            )  # donate-ok: THE job-end fetch — the one sanctioned sync
             bdev = 0
         if bflat == I32_MAX:
             return None
@@ -1323,8 +1327,20 @@ class SweepPipeline:
                 self._prewarmed.discard((len(data.encode("utf-8")), d))
 
     def close(self) -> None:
+        """Stop both worker threads and reap them (threadcheck): the
+        sentinel flows jobs -> dispatcher -> fetches -> fetcher, so both
+        exit once work queued ahead of it drains.  The joins are timed —
+        a wedged device future (the injected-wedge drill, a real stuck
+        runtime) must not turn close() into a hang; a timeout leaves the
+        daemon thread to the process reaper, which is exactly the
+        pre-ISSUE-19 behaviour, now as the fallback instead of the rule.
+        The bound is short on purpose: an idle pipeline reaps in
+        milliseconds, and a wedged one should cost a beat, not seconds,
+        in every fleet teardown."""
         self._closed = True
         self._jobs.put(None)
+        self._dispatcher.join(timeout=1)
+        self._fetcher.join(timeout=1)
 
     # ------------------------------------------------------------- threads
 

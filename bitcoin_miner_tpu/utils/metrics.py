@@ -383,6 +383,8 @@ def format_quantiles(h) -> str:
 #:   telemetry.decode_errors   telemetry payloads that failed to decode
 #:   slo.alerts_fired          SLO burn-rate alerts that transitioned to firing
 #:   slo.alerts_resolved       firing SLO alerts that cleared
+#:   sanitize.loop_blocked     blocking-on-loop trips raised by the sanitizer (ISSUE 19)
+#:   sanitize.threads_leaked   threads found beyond a census baseline at reap time
 #:   hist.request_s            request→result latency at the gateway (s)
 #:   hist.chunk_rtt_s          chunk dispatch→Result round-trip (s)
 #:   hist.admission_wait_s     admission-queue wait before dispatch (s)

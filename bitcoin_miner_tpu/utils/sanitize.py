@@ -22,6 +22,16 @@ pass.  With ``BMT_SANITIZE=1`` the dynamic half arms:
   two threads truly interleaving inside the same object are caught even
   before the thread-set heuristic trips.
 
+- The concurrency-plane teeth (ISSUE 19): :func:`loop_thread_enter`
+  registers event-loop threads, :func:`blocking` raises
+  :class:`LoopBlockedError` when a declared-blocking call runs ON one,
+  and :class:`TrackedLock` raises the same when a loop thread takes a
+  lock some other thread is known to hold while blocking on that loop —
+  the deterministic spelling of "one blocked loop iteration stalls every
+  conn on the cell".  :func:`thread_census` / :func:`threads_leaked` are
+  the always-on thread-lifecycle census the flat-thread regression legs
+  assert with (the runtime half of ``tools/analyze``'s ``thread`` pass).
+
 Disabled (the default), :func:`make_lock` returns a plain
 ``threading.Lock`` and :func:`guard` returns the object unchanged — zero
 overhead on the hot path.  The chaos soak and gateway suites run green
@@ -38,6 +48,7 @@ from typing import Any, Dict, Optional, Set, Tuple
 __all__ = [
     "RaceError",
     "LockOrderError",
+    "LoopBlockedError",
     "TrackedLock",
     "Monitor",
     "enabled",
@@ -46,7 +57,11 @@ __all__ = [
     "guard",
     "loop_thread_enter",
     "loop_wait",
+    "blocking",
+    "current_loop",
     "reset_order_graph",
+    "thread_census",
+    "threads_leaked",
 ]
 
 
@@ -57,6 +72,15 @@ class RaceError(AssertionError):
 class LockOrderError(AssertionError):
     """A lock acquisition that closes a cycle in the acquisition-order
     graph — the interleaving-dependent deadlock, caught deterministically."""
+
+
+class LoopBlockedError(AssertionError):
+    """A blocking primitive ran ON a registered event-loop thread — a
+    declared-blocking call (a sync facade proxy, ``blocking()``) or a
+    TrackedLock acquisition some other thread is known to hold while it
+    blocks on this very loop.  One blocked loop iteration stalls every
+    conn on the cell, so the sanitizer raises deterministically instead
+    of letting the stall surface as tail latency (ISSUE 19)."""
 
 
 #: Test override: force(True/False) beats the environment; force(None)
@@ -120,6 +144,14 @@ class _OrderGraph:
             stack.extend(self._edges.get(node, ()))
         return False
 
+    def reaches(self, src: str, dst: str) -> bool:
+        """Public query: does an edge path ``src -> ... -> dst`` exist?
+        (The blocking-on-loop detector asks whether some thread is known
+        to block on a loop while holding the lock a loop thread is about
+        to take.)"""
+        with self._mu:
+            return self._reaches(src, dst)
+
     def reset(self) -> None:
         with self._mu:
             self._edges.clear()
@@ -127,6 +159,35 @@ class _OrderGraph:
 
 _ORDER = _OrderGraph()
 _HELD = threading.local()  # per-thread stack of held TrackedLock names
+
+#: Registered event-loop threads: ident -> (thread object, loop name).
+#: The thread object disambiguates ident reuse after a loop dies (OS
+#: thread ids recycle); entries are validated against it on lookup.
+_LOOP_IDENTS: Dict[int, Tuple[Any, str]] = {}
+
+
+def current_loop() -> Optional[str]:
+    """The loop name the CURRENT thread registered via
+    :func:`loop_thread_enter`, or None when this is not a live registered
+    loop thread."""
+    entry = _LOOP_IDENTS.get(threading.get_ident())
+    if entry is None:
+        return None
+    thread, name = entry
+    if thread is not threading.current_thread():
+        return None  # a recycled ident: the old loop thread is gone
+    return name
+
+
+def _inc_metric(name: str, n: int = 1) -> None:
+    """Lazy registry import: sanitize must stay importable from metrics'
+    own dependency cone, so the counter hop resolves at trip time."""
+    try:
+        from .metrics import METRICS
+
+        METRICS.inc(name, n)  # metric-ok: sanitize.*
+    except Exception:
+        pass  # never let accounting mask the sanitizer error itself
 
 
 def _held_stack() -> list:
@@ -154,6 +215,23 @@ class TrackedLock:
         self._owner: Optional[int] = None  # thread ident; _lock serializes
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # Blocking-on-loop detector (ISSUE 19): taking a lock ON a
+        # registered loop thread is fine by itself (the event plane's
+        # handlers do it on every request) — but if some OTHER thread is
+        # known to block on this loop WHILE HOLDING this lock (a
+        # ``lock -> loop`` edge recorded by loop_wait), this acquisition
+        # is a deterministic deadlock-in-waiting that would stall every
+        # conn on the cell.  Raise the loop-specific error here, before
+        # the generic cycle check, so the report names the loop.
+        loop = current_loop()
+        if loop is not None and _ORDER.reaches(self.name, loop):
+            _inc_metric("sanitize.loop_blocked")
+            raise LoopBlockedError(
+                f"lock {self.name!r} acquired on loop thread {loop!r}, "
+                f"but another thread blocks on that loop while holding "
+                f"it — one loop iteration away from a full-cell stall "
+                f"(thread {threading.current_thread().name})"
+            )
         _ORDER.observe(tuple(_held_stack()), self.name)
         got = self._lock.acquire(blocking, timeout)
         if got:
@@ -212,9 +290,12 @@ def make_lock(name: str) -> Any:
 def loop_thread_enter(name: str) -> None:
     """Mark the CURRENT thread as an event-loop thread that permanently
     holds the loop resource ``name`` (called once, from the loop thread
-    itself, before the loop runs)."""
+    itself, before the loop runs).  Also registers the thread in the
+    loop-thread registry so :func:`blocking` and the TrackedLock
+    blocking-on-loop detector can answer "is this a loop thread"."""
     if enabled():
         _held_stack().append(name)
+        _LOOP_IDENTS[threading.get_ident()] = (threading.current_thread(), name)
 
 
 def loop_wait(name: str) -> None:
@@ -223,6 +304,84 @@ def loop_wait(name: str) -> None:
     if the loop were a lock being acquired."""
     if enabled():
         _ORDER.observe(tuple(_held_stack()), name)
+
+
+def blocking(what: str) -> None:
+    """Declare the statement that follows BLOCKS the calling thread
+    (a sync facade proxy wait, a bare ``Future.result()``, file I/O on a
+    shared path).  On a plain thread this is free; on a registered
+    event-loop thread it raises :class:`LoopBlockedError` outright —
+    a blocked loop iteration stalls every conn riding that loop, and no
+    interleaving makes it safe.  The static half of the same contract is
+    ``tools/analyze``'s ``loop`` pass (ISSUE 19)."""
+    if not enabled():
+        return
+    loop = current_loop()
+    if loop is not None:
+        _inc_metric("sanitize.loop_blocked")
+        raise LoopBlockedError(
+            f"declared-blocking call {what!r} on loop thread {loop!r} "
+            f"(thread {threading.current_thread().name}) — every conn on "
+            f"this loop stalls until it returns"
+        )
+
+
+# --------------------------------------------------------------------------
+# Thread-lifecycle census (ISSUE 19): the runtime half of the ``thread``
+# pass.  Always available (not gated on enabled()) — the flat-thread
+# regression legs in tests/test_ingress.py and tests/test_federation.py
+# ride these instead of hand-rolled ``threading.active_count()`` math.
+# --------------------------------------------------------------------------
+
+
+def thread_census(settle_s: float = 0.0) -> Dict[str, int]:
+    """Live threads right now, as a ``name -> count`` census.  With
+    ``settle_s`` the census waits (up to that long) for the live count to
+    stop shrinking first, so stragglers from an earlier fleet don't
+    inflate a baseline."""
+    import time as _time
+
+    if settle_s > 0.0:
+        deadline = _time.monotonic() + settle_s
+        prev = threading.active_count()
+        while _time.monotonic() < deadline:
+            _time.sleep(0.05)
+            now = threading.active_count()
+            if now >= prev:
+                break  # stopped shrinking
+            prev = now
+    out: Dict[str, int] = {}
+    for t in threading.enumerate():
+        out[t.name] = out.get(t.name, 0) + 1
+    return out
+
+
+def threads_leaked(
+    baseline: Dict[str, int], settle_s: float = 0.0
+) -> list:
+    """Thread names live now beyond their ``baseline`` census counts
+    (with multiplicity).  With ``settle_s`` the check polls until the
+    leak set drains or the deadline passes — close() paths joining with
+    timeouts need a beat.  A non-empty result increments the
+    ``sanitize.threads_leaked`` counter, so a soak that asserts flat
+    threads also feeds the metrics plane."""
+    import time as _time
+
+    def _leaked() -> list:
+        out = []
+        for name, count in thread_census().items():
+            extra = count - baseline.get(name, 0)
+            out.extend([name] * extra if extra > 0 else [])
+        return sorted(out)
+
+    leaked = _leaked()
+    deadline = _time.monotonic() + settle_s
+    while leaked and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+        leaked = _leaked()
+    if leaked:
+        _inc_metric("sanitize.threads_leaked", len(leaked))
+    return leaked
 
 
 # --------------------------------------------------------------------------

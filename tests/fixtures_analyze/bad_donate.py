@@ -1,0 +1,82 @@
+"""Seeded donation-safety violations for the ``donate`` pass
+(tools/analyze/donatecheck.py) — every rule must fire on this file:
+
+- ``drops_result`` calls a donated step without rebinding the donated
+  operand (``donate-no-rebind``);
+- ``reads_dead_handle`` additionally reads the dead operand afterwards
+  (``donate-read-after-call``);
+- ``factory_route`` binds its step from a hot-step factory (the
+  repo convention: callee named like ``*hot_step*`` donates argument 0)
+  and discards the result (``donate-no-rebind``);
+- ``HotThing.peek`` / ``HotThing.finish`` materialise the donated job
+  carry mid-job (``donate-materialize`` — int() over it, iterating it).
+
+And the idioms that must stay CLEAN: the carry rebind in
+``HotThing.dispatch`` (the exact ``_HotLoop.dispatch`` shape), the
+``carry is None`` refresh test, and ``# donate-ok:`` suppressions.
+
+The file is only parsed, never imported — ``jax`` here is a stand-in
+name so the AST carries the real call shapes.
+"""
+
+import jax  # noqa: F401  (parsed, not imported — see module docstring)
+
+
+def _step_impl(carry, x):
+    return carry, x
+
+
+_step = jax.jit(_step_impl, donate_argnums=(0,))
+
+
+def make_hot_step_stub(kern):
+    return _step_impl
+
+
+def drops_result(carry, x):
+    probe = _step(carry, x)  # VIOLATION donate-no-rebind
+    return probe
+
+
+def reads_dead_handle(carry, x):
+    out = _step(carry, x)  # VIOLATION donate-no-rebind
+    return carry[0], out  # VIOLATION donate-read-after-call
+
+
+def factory_route(carry, x):
+    step = make_hot_step_stub(None)
+    step(carry, x)  # VIOLATION donate-no-rebind (result discarded)
+
+
+def clean_rebind(carry, x):
+    carry, probe = _step(carry, x)  # clean: the donated call rebinds
+    return carry, probe
+
+
+def sanctioned_drop(carry, x):
+    probe = _step(carry, x)  # donate-ok: fixture-sanctioned throwaway
+    return probe
+
+
+class HotThing:
+    """The donated-carry class shape (``_HotLoop`` in ops/sweep.py)."""
+
+    def __init__(self):
+        self._carry = None
+        self._step = jax.jit(_step_impl, donate_argnums=(0,))
+
+    def dispatch(self, x):
+        if self._carry is None:  # clean: a None test is not a sync
+            self._carry = (x,)
+        # clean: the hot-carry rebind — pointer stability by construction
+        self._carry, probe = self._step(self._carry, x)
+        return probe
+
+    def peek(self):
+        return int(self._carry[0])  # VIOLATION donate-materialize
+
+    def finish(self):
+        return [int(v) for v in self._carry]  # VIOLATION donate-materialize
+
+    def finish_sanctioned(self):
+        return tuple(self._carry)  # donate-ok: THE job-end fetch

@@ -1,0 +1,73 @@
+"""Seeded loop-discipline violations for the ``loop`` pass
+(tools/analyze/loopcheck.py) — every rule must fire on this file:
+
+- ``handler`` sleeps and opens a file inside a coroutine
+  (``loop-blocking-call`` ×2);
+- ``locked_handler`` takes a sync lock on the loop (``loop-lock``) and
+  blocks on a Future (``loop-blocking-call``);
+- ``on_loop_callback`` is a plain def declared ``# on-loop:`` that
+  sleeps (``loop-blocking-call`` — the annotation is what puts it in
+  scope);
+- ``BadBridge.write`` calls a loop-owned field without the
+  ``call_soon_threadsafe`` hop (``loop-off-thread-write``).
+
+And the idioms that must stay CLEAN: awaited reads, the thread-identity
+fast path, the threadsafe hop itself, and ``# loop-ok:`` suppressions.
+"""
+
+import threading
+import time
+
+
+class BadBridge:
+    """A loop-owned field written off-thread."""
+
+    def __init__(self, server, loop):
+        self.srv = server  # on-loop: loop_attr
+        self.loop_attr = loop
+        self._thread = threading.current_thread()
+
+    def write(self, conn_id, payload):
+        # VIOLATION loop-off-thread-write: bypasses the hop
+        self.srv.write(conn_id, payload)
+
+    def write_hopped(self, conn_id, payload):
+        if threading.current_thread() is self._thread:
+            self.srv.write(conn_id, payload)  # clean: identity fast path
+            return
+        # clean: the sanctioned hop (the bound method is an argument)
+        self.loop_attr.call_soon_threadsafe(self.srv.write, conn_id, payload)
+
+    def snapshot(self):
+        return self.srv.conns_live()  # loop-ok: GIL-atomic snapshot read
+
+
+async def handler(conn):
+    time.sleep(0.1)  # VIOLATION loop-blocking-call: sync sleep on the loop
+    fh = open("/tmp/bad_loop_fixture")  # VIOLATION loop-blocking-call: file I/O
+    fh.close()
+    return conn
+
+
+async def locked_handler(lock, fut):
+    with lock:  # VIOLATION loop-lock: sync lock in a coroutine
+        pass
+    return fut.result()  # VIOLATION loop-blocking-call: Future wait
+
+
+async def clean_handler(conn, lock):
+    import asyncio
+
+    await asyncio.sleep(0)  # clean: awaited
+    async with lock:  # clean: the async lock spelling
+        pass
+    return await conn.read()  # clean: awaited read
+
+
+async def suppressed_handler():
+    time.sleep(0)  # loop-ok: fixture-sanctioned zero-sleep
+
+
+def on_loop_callback(state):  # on-loop: scheduled via call_soon_threadsafe
+    time.sleep(0.5)  # VIOLATION loop-blocking-call: annotated def is on-loop
+    return state

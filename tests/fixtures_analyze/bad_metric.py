@@ -33,6 +33,11 @@
 - ``autoscale.fixture_actions`` is documented below but never emitted
   (``metric-unused`` — pins the ``autoscale.*`` action-counter family,
   which stays inc-kind, in the registry cross-check);
+- ``sanitize.fixture_trips`` is documented below but never emitted
+  (``metric-unused`` — pins the ``sanitize.*`` sanitizer-trip counter
+  family (ISSUE 19: ``sanitize.loop_blocked``,
+  ``sanitize.threads_leaked``), which stays inc-kind, in the registry
+  cross-check);
 - the computed-name ``inc`` cannot be registry-checked at all
   (``metric-dynamic-name``).
 """
@@ -62,6 +67,7 @@ class Metrics:  # stand-in so the fixture never imports the real package
 #:   autoscale.target_workers  the capacity plane's fleet-size gauge (set_gauge-only kind)
 #:   fed.conns_live            the federation shared-loop conn gauge (set_gauge-only kind)
 #:   autoscale.fixture_actions an autoscale action counter, documented but never emitted
+#:   sanitize.fixture_trips    a sanitizer trip counter, documented but never emitted
 METRICS = Metrics()
 
 

@@ -1,0 +1,84 @@
+"""Seeded thread-lifecycle violations for the ``thread`` pass
+(tools/analyze/threadcheck.py):
+
+- ``LeakyWorker`` stores a thread on the instance and its ``close()``
+  never joins it (``thread-unjoined`` — daemon status does NOT exempt a
+  class-owned thread: it still holds the object alive after close());
+- ``leaky_local`` builds a non-daemon fire-and-forget thread nobody
+  joins (``thread-unjoined``).
+
+And the idioms that must stay CLEAN: the reaper join (direct and the
+for-loop-over-``self._threads`` spelling), the wait-for-workers local
+join, daemon fire-and-forget locals, and the ``# thread-owner:``
+deliberate-abandon annotation.
+"""
+
+import threading
+
+
+def _work():
+    pass
+
+
+class LeakyWorker:
+    def __init__(self):
+        # VIOLATION thread-unjoined: close() below never joins it
+        self._t = threading.Thread(target=_work, daemon=True)
+        self._t.start()
+
+    def close(self):
+        pass
+
+
+class CleanWorker:
+    def __init__(self, n):
+        self._t = threading.Thread(target=_work)
+        self._t.start()
+        self._threads = []
+        for _ in range(n):
+            t = threading.Thread(target=_work)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._t.join(timeout=1)
+        for t in self._threads:
+            t.join(timeout=1)
+
+
+class AbandonedByDesign:
+    """The tiered-watchdog shape: close() must never block behind a
+    wedged worker, so the daemon thread is deliberately left to the
+    process reaper."""
+
+    def __init__(self):
+        self._t = threading.Thread(
+            target=_work, daemon=True
+        )  # thread-owner: process — deliberate abandon, see docstring
+        self._t.start()
+
+    def close(self):
+        pass
+
+
+def leaky_local():
+    # VIOLATION thread-unjoined: non-daemon, never joined, not annotated
+    t = threading.Thread(target=_work)
+    t.start()
+
+
+def clean_local_join(n):
+    ts = [threading.Thread(target=_work) for _ in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def clean_local_daemon():
+    threading.Thread(target=_work, daemon=True).start()
+
+
+def annotated_local():
+    t = threading.Thread(target=_work)  # thread-owner: harness.teardown
+    t.start()

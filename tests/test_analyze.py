@@ -19,6 +19,7 @@ import json
 import subprocess
 import sys
 import threading
+import time
 from pathlib import Path
 
 import pytest
@@ -34,6 +35,7 @@ if str(REPO) not in sys.path:  # make `tools.analyze` importable in-process
 from tools.analyze import PASSES, apply_ratchet, load_ratchet, save_ratchet
 from tools.analyze import contracts as contracts_pass
 from tools.analyze.common import DEFAULT_SCAN_DIRS, Finding
+from tools.analyze.donatecheck import DONATE_SCAN_DIRS
 from tools.analyze.tracecheck import TRACE_SCAN_DIRS
 
 from bitcoin_miner_tpu.utils import sanitize
@@ -49,10 +51,17 @@ def _pass_findings(name, root, scan=None):
 
 
 @pytest.mark.parametrize(
-    "name", ["lock", "wfq", "trace", "contracts", "sanitize", "metrics"]
+    "name",
+    [
+        "lock", "wfq", "trace", "contracts", "sanitize", "metrics",
+        "loop", "donate", "thread",
+    ],
 )
 def test_repo_is_clean(name):
-    scan = TRACE_SCAN_DIRS if name == "trace" else DEFAULT_SCAN_DIRS
+    scan = {
+        "trace": TRACE_SCAN_DIRS,
+        "donate": DONATE_SCAN_DIRS,
+    }.get(name, DEFAULT_SCAN_DIRS)
     findings = _pass_findings(name, REPO, scan)
     ratchet = load_ratchet(REPO / "tools" / "analyze" / "ratchet.json")
     new, stale = apply_ratchet(findings, ratchet)
@@ -83,7 +92,7 @@ def test_cli_fixture_mode_exits_nonzero():
     assert res.returncode == 1, res.stdout + res.stderr
     # Every pass contributed at least one finding to the output.
     for tag in ("[lock/", "[wfq/", "[contracts/", "[trace/", "[sanitize/",
-                "[metrics/"):
+                "[metrics/", "[loop/", "[donate/", "[thread/"):
         assert tag in res.stdout, f"{tag} never fired:\n{res.stdout}"
 
 
@@ -226,6 +235,94 @@ def test_metrics_rules_fire_on_fixture():
     assert ("metric-unused", "autoscale.fixture_actions") in {
         (f.rule, f.symbol) for f in findings
     }
+    # sanitize.* is the sanitizer trip-counter family (ISSUE 19) — stays
+    # inc-kind, pinned by the unused-row cross-check.
+    assert ("metric-unused", "sanitize.fixture_trips") in {
+        (f.rule, f.symbol) for f in findings
+    }
+
+
+def test_loop_rules_fire_on_fixture():
+    """Every loop-discipline rule fires on bad_loop.py — and none of the
+    legal idioms (awaited calls, async-with locks, the identity fast
+    path, the threadsafe hop, `# loop-ok:` suppressions) fire."""
+    findings = _pass_findings("loop", FIXTURES)
+    assert {
+        "loop-blocking-call",
+        "loop-lock",
+        "loop-off-thread-write",
+    } <= _rules(findings)
+    rules_syms = {(f.rule, f.symbol) for f in findings}
+    # The off-thread write on the annotated loop-owned field...
+    assert ("loop-off-thread-write", "BadBridge.write") in rules_syms
+    # ...the sync sleep / file open / Future wait inside coroutines...
+    assert ("loop-blocking-call", "handler") in rules_syms
+    assert ("loop-blocking-call", "locked_handler") in rules_syms
+    assert ("loop-lock", "locked_handler") in rules_syms
+    # ...and a PLAIN def pulled into scope by its `# on-loop:` header.
+    assert ("loop-blocking-call", "on_loop_callback") in rules_syms
+    # The clean idioms never appear at all.
+    symbols = {f.symbol for f in findings}
+    for clean in (
+        "BadBridge.write_hopped",  # identity fast path + threadsafe hop
+        "BadBridge.snapshot",      # trailing # loop-ok:
+        "clean_handler",           # awaited read / async with
+        "suppressed_handler",      # trailing # loop-ok:
+        "BadBridge.__init__",      # the annotation site itself
+    ):
+        assert clean not in symbols, (clean, symbols)
+
+
+def test_donate_rules_fire_on_fixture():
+    """Every donation-safety rule fires on bad_donate.py — via both the
+    explicit ``jax.jit(..., donate_argnums=...)`` spelling and the
+    hot-step factory convention — while the hot-carry rebind idiom
+    (the exact ``_HotLoop.dispatch`` shape), the ``carry is None``
+    refresh test, and ``# donate-ok:`` suppressions stay clean."""
+    findings = _pass_findings("donate", FIXTURES)
+    assert {
+        "donate-no-rebind",
+        "donate-read-after-call",
+        "donate-materialize",
+    } <= _rules(findings)
+    rules_syms = {(f.rule, f.symbol) for f in findings}
+    assert ("donate-no-rebind", "drops_result") in rules_syms
+    assert ("donate-no-rebind", "reads_dead_handle") in rules_syms
+    assert ("donate-read-after-call", "reads_dead_handle") in rules_syms
+    # The factory route: callee named like *hot_step* donates arg 0.
+    assert ("donate-no-rebind", "factory_route") in rules_syms
+    # Mid-job materialization of the donated carry, both spellings.
+    assert ("donate-materialize", "HotThing.peek") in rules_syms
+    assert ("donate-materialize", "HotThing.finish") in rules_syms
+    symbols = {f.symbol for f in findings}
+    for clean in (
+        "clean_rebind",              # the donated call rebinds
+        "sanctioned_drop",           # trailing # donate-ok:
+        "HotThing.dispatch",         # hot-carry rebind + None test
+        "HotThing.finish_sanctioned",  # the annotated job-end fetch
+    ):
+        assert clean not in symbols, (clean, symbols)
+
+
+def test_thread_rules_fire_on_fixture():
+    """thread-unjoined fires on both ownership shapes — the class-owned
+    thread whose close() never joins it (daemon does NOT exempt) and the
+    fire-and-forget non-daemon local — while the reaper joins (direct
+    and for-loop-over-list), the wait-for-workers local join, daemon
+    locals, and `# thread-owner:` abandons stay clean."""
+    findings = _pass_findings("thread", FIXTURES)
+    assert "thread-unjoined" in _rules(findings)
+    symbols = {f.symbol for f in findings}
+    assert "LeakyWorker.__init__" in symbols
+    assert "leaky_local" in symbols
+    for clean in (
+        "CleanWorker.__init__",       # joined in stop(), both spellings
+        "AbandonedByDesign.__init__",  # trailing # thread-owner:
+        "clean_local_join",
+        "clean_local_daemon",
+        "annotated_local",
+    ):
+        assert clean not in symbols, (clean, symbols)
 
 
 def test_metrics_pass_honors_metric_ok_declaration(tmp_path):
@@ -483,6 +580,68 @@ def test_lockfix_handles_serve_loop_locals(tmp_path):
     assert _pass_findings("lock", tmp_path) == []
 
 
+_HOPPABLE = """\
+class Bridge:
+    def __init__(self, server, loop):
+        self.srv = server  # on-loop: lp
+        self.lp = loop
+
+    def poke(self, conn_id, payload):
+        self.srv.write(conn_id, payload)
+"""
+
+_UNHOPPABLE = """\
+class Bridge:
+    def __init__(self, server, loop):
+        self.srv = server  # on-loop: lp
+        self.lp = loop
+
+    def query(self, conn_id):
+        n = self.srv.pending(conn_id)
+        return n
+"""
+
+
+def test_lockfix_hops_simple_off_loop_writes(tmp_path):
+    """ISSUE 19: a bare fire-and-forget call on a loop-owned field is
+    mechanically rewritten to the call_soon_threadsafe hop the finding
+    message spells, the loop pass then finds nothing, and a second run
+    has nothing to do."""
+    (tmp_path / "bridge.py").write_text(_HOPPABLE)
+    res = _run_lockfix(tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    fixed = (tmp_path / "bridge.py").read_text()
+    assert (
+        "self.lp.call_soon_threadsafe(self.srv.write, conn_id, payload)"
+        in fixed
+    )
+    assert _pass_findings("loop", tmp_path) == []  # recheck is clean
+    res2 = _run_lockfix(tmp_path)
+    assert res2.returncode == 0
+    assert (tmp_path / "bridge.py").read_text() == fixed  # idempotent
+
+
+def test_lockfix_refuses_hops_that_need_the_return_value(tmp_path):
+    """A write whose result is bound cannot become a fire-and-forget
+    hop — the file stays byte-identical and the review block names the
+    spot."""
+    (tmp_path / "bridge.py").write_text(_UNHOPPABLE)
+    res = _run_lockfix(tmp_path)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert (tmp_path / "bridge.py").read_text() == _UNHOPPABLE
+    assert "NOT auto-hoppable" in res.stdout
+    assert "Bridge.query" in res.stdout
+    assert "n = self.srv.pending(conn_id)" in res.stdout  # the context
+
+
+def test_lockfix_hop_dry_run_touches_nothing(tmp_path):
+    (tmp_path / "bridge.py").write_text(_HOPPABLE)
+    res = _run_lockfix(tmp_path, "--dry-run")
+    assert (tmp_path / "bridge.py").read_text() == _HOPPABLE
+    assert "proposed (dry run)" in res.stdout
+    assert "+        self.lp.call_soon_threadsafe(self.srv.write" in res.stdout
+
+
 def test_lockfix_repo_mode_is_a_noop_on_a_clean_repo():
     """The repo carries no findings, so --fix must change nothing (and
     exit 0) — the tier-1-safe property."""
@@ -732,3 +891,148 @@ def test_serve_loop_discipline_clean_under_monitor(sanitizer):
     for t in threads:
         t.join()
     assert not errors, errors
+
+
+# --------------------------------------------------------------------------
+# 5. Loop-discipline runtime (ISSUE 19): the dynamic half of the `loop`
+#    pass — blocking() declarations, the graph-based lock-on-loop check,
+#    and the always-on thread census the flat-thread legs ride.
+# --------------------------------------------------------------------------
+
+
+def _returning_exc(fn):
+    """Run ``fn``, returning the exception it raised (or None)."""
+    try:
+        fn()
+    except BaseException as e:
+        return e
+    return None
+
+
+def test_blocking_raises_only_on_registered_loop_threads(sanitizer):
+    """sanitize.blocking() is free on a plain thread and a hard
+    LoopBlockedError on a registered loop thread — the runtime spelling
+    of loopcheck's loop-blocking-call rule."""
+    from bitcoin_miner_tpu.lsp.sync import _LoopThread
+
+    sanitize.blocking("test.plain_thread")  # plain thread: free
+    lt = _LoopThread("san-blocking")
+    try:
+        err = lt.call(
+            lambda: _returning_exc(lambda: sanitize.blocking("test.on_loop"))
+        )
+        assert isinstance(err, sanitize.LoopBlockedError), err
+    finally:
+        lt.stop()
+    sanitize.blocking("test.after_stop")  # still free off-loop
+
+
+def test_cross_loop_facade_wait_raises_loop_blocked(sanitizer):
+    """A loop thread blocking on ANOTHER loop's proxy Future is the trip
+    the sync facades now declare via sanitize.blocking: the nested call
+    raises instead of stalling every conn riding the outer loop."""
+    from bitcoin_miner_tpu.lsp.sync import _LoopThread
+
+    a = _LoopThread("san-cross-a")
+    b = _LoopThread("san-cross-b")
+    try:
+        err = a.call(
+            lambda: _returning_exc(lambda: b.call(lambda: None))
+        )
+        assert isinstance(err, sanitize.LoopBlockedError), err
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_tracked_lock_on_loop_thread_uses_the_block_edge(sanitizer):
+    """Taking a tracked lock ON a loop thread is legal in itself (the
+    event plane does it every event) — it only becomes a refusal once
+    some thread has BLOCKED on that loop while holding the same lock,
+    because the next on-loop acquisition then closes a deadlock cycle."""
+    from bitcoin_miner_tpu.lsp.sync import _LoopThread
+
+    def take(lock):
+        return _returning_exc(lambda: lock.acquire()) or lock.release()
+
+    free = sanitize.TrackedLock("san.loopedge.free")
+    event = sanitize.TrackedLock("san.loopedge.event")
+    lt = _LoopThread("san-loopedge")
+    try:
+        # No block edge: an on-loop acquisition is silent.
+        assert lt.call(lambda: take(free)) in (None, False)
+        # Record event->loop: a thread blocks on the loop holding event.
+        with event:
+            lt.call(lambda: None)
+        # Now the same lock ON the loop thread is the deadlock cycle.
+        err = lt.call(lambda: _returning_exc(event.acquire))
+        assert isinstance(err, sanitize.LoopBlockedError), err
+    finally:
+        lt.stop()
+
+
+def test_thread_census_and_leak_check():
+    """The always-on runtime half of the `thread` pass: the census
+    baselines by name, threads_leaked names offenders (and feeds the
+    sanitize.threads_leaked counter), and a reaped fleet drains clean."""
+    from bitcoin_miner_tpu.utils.metrics import METRICS
+
+    base = sanitize.thread_census()
+    before = METRICS.get("sanitize.threads_leaked")
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="census-probe")
+    t.start()
+    try:
+        leaked = sanitize.threads_leaked(base)
+        assert leaked.count("census-probe") == 1, leaked
+        assert METRICS.get("sanitize.threads_leaked") >= before + 1
+    finally:
+        stop.set()
+        t.join()
+    assert sanitize.threads_leaked(base, settle_s=5.0) == []
+
+
+# --------------------------------------------------------------------------
+# 6. Incremental mode: --changed (ISSUE 19), the pre-commit-hook shape
+# --------------------------------------------------------------------------
+
+
+def test_cli_changed_mode_agrees_with_full_run_and_is_fast():
+    """--changed must reach the same verdict as the full run (scoping
+    may skip work, never flip the exit code) AND clear the pre-commit
+    bar: a warm scoped run over a small diff in well under five seconds
+    (a full run pays the whole-repo parse; the scoped run must not).
+    The full run doubles as the cache warmer for the timed leg."""
+    full = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "-q"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    probe = REPO / "bitcoin_miner_tpu" / "_changed_probe.py"
+    probe.write_text(
+        '"""Untracked --changed timing probe (created and removed by '
+        'tests/test_analyze.py)."""\n'
+    )
+    try:
+        t0 = time.monotonic()
+        inc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", "--changed", "-q"],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        dt = time.monotonic() - t0
+    finally:
+        probe.unlink()
+    assert inc.returncode == full.returncode, (
+        full.stdout + full.stderr + inc.stdout + inc.stderr
+    )
+    assert dt < 5.0, f"--changed took {dt:.2f}s on a small diff"
+
+
+def test_cli_changed_rejects_incompatible_flags():
+    """--changed scopes the LIVE repo against git: combining it with an
+    alternate --root or with --update-ratchet is a usage error."""
+    for extra in (["--root", str(FIXTURES)], ["--update-ratchet"]):
+        res = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", "--changed", *extra],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert res.returncode == 2, (extra, res.stdout, res.stderr)

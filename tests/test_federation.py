@@ -47,6 +47,7 @@ from bitcoin_miner_tpu.federation.membership import (
     SUSPECT,
 )
 from bitcoin_miner_tpu.lspnet.chaos import CHAOS
+from bitcoin_miner_tpu.utils import sanitize
 from bitcoin_miner_tpu.utils.metrics import METRICS
 from bitcoin_miner_tpu.utils.telemetry import FrameAssembler
 
@@ -1113,10 +1114,10 @@ def test_fed_plane_threads_flat_as_peers_grow():
     public side; this pins the peer side."""
 
     def per_cell(n):
-        # Let stragglers from earlier tests/fleets die before baselining.
-        base = threading.active_count()
-        _wait(lambda: threading.active_count() <= base, timeout=2.0)
-        before = threading.active_count()
+        # Let stragglers from earlier tests/fleets die before baselining
+        # — the census settle window is the old wait-for-shrink dance,
+        # now spelled via the sanitizer helper (ISSUE 19).
+        before = sanitize.thread_census(settle_s=2.0)
         fleet = FedFleet(n=n, miners=0, gossip_interval=0.05)
         try:
             # Every cell's fed server must hold a live conn FROM each
@@ -1127,7 +1128,9 @@ def test_fed_plane_threads_flat_as_peers_grow():
                 for rep in fleet.replicas.values()
             )), {nm: rep.fed.conns_live() for nm, rep in fleet.replicas.items()}
             conns = sum(r.fed.conns_live() for r in fleet.replicas.values())
-            threads = threading.active_count() - before
+            threads = sum(sanitize.thread_census().values()) - sum(
+                before.values()
+            )
         finally:
             fleet.close()
         assert threads % n == 0, (threads, n)

@@ -1,6 +1,6 @@
 """Repo-native static-analysis & sanitizer suite (``python -m tools.analyze``).
 
-Five passes, one exit code:
+Nine passes, one exit code:
 
 - ``lock`` — AST lock-discipline checker (``# guarded-by:`` annotations,
   the ``with``-block rule, the ``_locked``/def-line helper conventions,
@@ -18,6 +18,16 @@ Five passes, one exit code:
 - ``metrics`` — every counter/histogram/gauge name emitted anywhere must
   appear in the documented registry block in utils/metrics.py, and vice
   versa (documented-but-never-emitted fails).  tools/analyze/metriccheck.py
+- ``loop`` — asyncio loop-discipline lint: blocking primitives in
+  coroutines / ``# on-loop:`` code, sync locks on the loop, off-thread
+  writes bypassing the ``call_soon_threadsafe`` hop.
+  tools/analyze/loopcheck.py
+- ``donate`` — JAX donation-safety pass over ops/ + parallel/:
+  use-after-donate, donated calls that don't rebind the carry, mid-job
+  carry materialisation.  tools/analyze/donatecheck.py
+- ``thread`` — thread-lifecycle sanitizer: every ``threading.Thread``
+  construction joined on its class's close()/stop()/shutdown() path or
+  annotated ``# thread-owner:``.  tools/analyze/threadcheck.py
 
 Grandfathered findings live in tools/analyze/ratchet.json and may only
 shrink.  See README "Static analysis & sanitizers".
@@ -26,7 +36,17 @@ shrink.  See README "Static analysis & sanitizers".
 from __future__ import annotations
 
 from .common import Finding, apply_ratchet, load_ratchet, save_ratchet  # noqa: F401
-from . import contracts, lockcheck, metriccheck, sanitcheck, tracecheck, wfqcheck  # noqa: F401
+from . import (  # noqa: F401
+    contracts,
+    donatecheck,
+    lockcheck,
+    loopcheck,
+    metriccheck,
+    sanitcheck,
+    threadcheck,
+    tracecheck,
+    wfqcheck,
+)
 
 PASSES = {
     "lock": lockcheck.run,
@@ -35,4 +55,7 @@ PASSES = {
     "trace": tracecheck.run,
     "sanitize": sanitcheck.run,
     "metrics": metriccheck.run,
+    "loop": loopcheck.run,
+    "donate": donatecheck.run,
+    "thread": threadcheck.run,
 }

@@ -9,14 +9,17 @@ every PR (tests/test_analyze.py), so the passes stay fast,
     python -m tools.analyze --pass lock,wfq      # a subset
     python -m tools.analyze --root tests/fixtures_analyze   # fixture tree
     python -m tools.analyze --update-ratchet     # after FIXING findings
+    python -m tools.analyze --changed            # files changed vs HEAD
+    python -m tools.analyze --changed main       # ... vs a ref
 """
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
-from typing import List
+from typing import List, Optional, Tuple
 
 from . import PASSES
 from .common import (
@@ -27,9 +30,53 @@ from .common import (
     load_ratchet,
     save_ratchet,
 )
+from .donatecheck import DONATE_SCAN_DIRS
 from .tracecheck import TRACE_SCAN_DIRS
 
 DEFAULT_RATCHET = Path(__file__).resolve().parent / "ratchet.json"
+
+#: Per-file passes can run on exactly the changed files.  The rest
+#: (contracts, sanitize, metrics) are whole-repo cross-checks: metrics
+#: must re-balance emitters against the registry after ANY change, while
+#: contracts/sanitize self-tests only depend on their trigger dirs.
+_PER_FILE_PASSES = frozenset({"lock", "wfq", "trace", "loop", "donate", "thread"})
+_WHOLE_PASS_TRIGGERS = {
+    "contracts": ("bitcoin_miner_tpu/bitcoin", "bitcoin_miner_tpu/lsp",
+                  "bitcoin_miner_tpu/apps", "tools/analyze"),
+    "sanitize": ("bitcoin_miner_tpu/utils", "bitcoin_miner_tpu/apps",
+                 "tools/analyze"),
+    "metrics": DEFAULT_SCAN_DIRS,
+}
+
+
+def _scan_dirs_for(name: str) -> Tuple[str, ...]:
+    if name == "trace":
+        return TRACE_SCAN_DIRS
+    if name == "donate":
+        return DONATE_SCAN_DIRS
+    return DEFAULT_SCAN_DIRS
+
+
+def _changed_files(root: Path, ref: str) -> Optional[List[str]]:
+    """Repo-relative .py paths changed vs ``ref`` (committed diff, index,
+    worktree, plus untracked), or None when git cannot answer."""
+    out: List[str] = []
+    for cmd in (
+        ["git", "diff", "--name-only", ref, "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.extend(line.strip() for line in proc.stdout.splitlines() if line.strip())
+    return sorted(
+        {p for p in out if p.endswith(".py") and (root / p).exists()}
+    )
 
 
 def main(argv: List[str] | None = None) -> int:
@@ -77,6 +124,17 @@ def main(argv: List[str] | None = None) -> int:
         help="rewrite the ratchet from current findings (only for locking "
         "in FIXES — never to admit new findings)",
     )
+    ap.add_argument(
+        "--changed",
+        nargs="?",
+        const="HEAD",
+        default=None,
+        metavar="REF",
+        help="incremental mode: per-file passes run only on files changed "
+        "vs REF (default HEAD, incl. uncommitted + untracked); whole-repo "
+        "passes run fully when a trigger dir changed, else skip.  Same "
+        "exit codes — cheap enough for a pre-commit hook (see README)",
+    )
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -110,13 +168,55 @@ def main(argv: List[str] | None = None) -> int:
     repo_mode = args.root is None
     root = REPO_ROOT if repo_mode else Path(args.root).resolve()
 
+    changed: Optional[List[str]] = None
+    if args.changed is not None:
+        if not repo_mode:
+            print("--changed only applies in repo mode", file=sys.stderr)
+            return 2
+        if args.update_ratchet:
+            print("--changed cannot update the ratchet (a partial scan "
+                  "would erase unscanned grandfathers)", file=sys.stderr)
+            return 2
+        changed = _changed_files(root, args.changed)
+        if changed is None:
+            print(
+                "--changed: git unavailable, running the full suite",
+                file=sys.stderr,
+            )
+
     findings: List[Finding] = []
+    # pass name -> scanned-paths set (per-file) or None (ran fully);
+    # passes skipped by --changed are absent, and their ratchet keys are
+    # out of scope for the stale check this run.
+    ran_scope: dict = {}
     for name in names:
         run = PASSES[name]
         if repo_mode:
-            scan = TRACE_SCAN_DIRS if name == "trace" else DEFAULT_SCAN_DIRS
+            scan = _scan_dirs_for(name)
+            if changed is not None:
+                if name in _PER_FILE_PASSES:
+                    scoped = tuple(
+                        p for p in changed
+                        if any(p == d or p.startswith(d + "/") for d in scan)
+                    )
+                    if not scoped:
+                        continue
+                    scan = scoped
+                    ran_scope[name] = set(scoped)
+                else:
+                    triggers = _WHOLE_PASS_TRIGGERS.get(name, DEFAULT_SCAN_DIRS)
+                    if not any(
+                        p == d or p.startswith(d + "/")
+                        for p in changed
+                        for d in triggers
+                    ):
+                        continue
+                    ran_scope[name] = None
+            else:
+                ran_scope[name] = None
         else:
             scan = None  # the whole fixture tree
+            ran_scope[name] = None
         if name == "contracts" and not repo_mode:
             bad = list(root.rglob("bad_contract.py"))
             if not bad:
@@ -150,6 +250,18 @@ def main(argv: List[str] | None = None) -> int:
         return 0
 
     ratchet = load_ratchet(ratchet_path) if ratchet_path else {}
+    if changed is not None:
+        # An incremental run only sees the changed files' findings, so
+        # only the matching ratchet slice participates — otherwise every
+        # unscanned grandfather would read as stale.
+        def _in_scope(key: str) -> bool:
+            pass_name, path = key.split(":", 2)[:2]
+            if pass_name not in ran_scope:
+                return False
+            scope = ran_scope[pass_name]
+            return scope is None or path in scope
+
+        ratchet = {k: v for k, v in ratchet.items() if _in_scope(k)}
     new, stale = apply_ratchet(findings, ratchet)
     grandfathered = len(findings) - len(new)
 

@@ -7,6 +7,7 @@ README "Static analysis & sanitizers"), and the only-shrink ratchet.
 
 from __future__ import annotations
 
+import ast
 import json
 import re
 import tokenize
@@ -63,6 +64,21 @@ def iter_py_files(root: Path, scan_dirs: Optional[Tuple[str, ...]] = None) -> It
             yield p
 
 
+def walk_shallow(fn: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` over one function's OWN body: nested function/class
+    defs (and lambdas) are yielded but not descended into, so a nested
+    def's statements are attributed to the nested symbol, never double-
+    reported under the enclosing one."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
 def rel(path: Path, root: Path) -> str:
     try:
         return path.resolve().relative_to(root.resolve()).as_posix()
@@ -78,6 +94,16 @@ GUARDED_BY_RE = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
 UNGUARDED_RE = re.compile(r"unguarded:")
 TRACE_OK_RE = re.compile(r"trace-ok:")
 JIT_KERNEL_RE = re.compile(r"jit-kernel\b")
+#: Concurrency-plane vocabulary (ISSUE 19).  ``# on-loop:`` on a ``def``
+#: declares the body runs on an event-loop thread (loopcheck lints it
+#: like a coroutine); on a ``self.<field> = ...`` assignment it declares
+#: the field loop-owned, with the optional argument naming the loop
+#: attribute off-thread writers must hop through
+#: (``# on-loop: _loop`` -> ``self._loop.call_soon_threadsafe``).
+ON_LOOP_RE = re.compile(r"(?<![\w-])on-loop:?\s*([A-Za-z_][A-Za-z0-9_]*)?")
+LOOP_OK_RE = re.compile(r"loop-ok:")
+DONATE_OK_RE = re.compile(r"donate-ok:")
+THREAD_OWNER_RE = re.compile(r"thread-owner:\s*(\S+)")
 
 
 def file_comments(source: str) -> Dict[int, str]:

@@ -1,0 +1,357 @@
+"""Pass 8: the JAX donation-safety pass (ISSUE 19).
+
+The hot device plane (:func:`ops.sweep.make_hot_step`, ``_HotLoop``)
+lives on ``donate_argnums``: the carry's device buffer is reused in
+place by every step, so the whole plane is correct only while three
+disciplines hold.  This pass makes them build-time properties:
+
+- ``donate-no-rebind`` — a call to a donated callable whose result does
+  not rebind the donated operand.  After the call the operand's buffer
+  is dead; keeping the old name live is a use-after-donate waiting to
+  happen (and XLA falls back to a silent copy if the handle is still
+  referenced).
+- ``donate-read-after-call`` — the donated operand is read again after
+  the donated call (before any rebind) in the same suite.  Dead-buffer
+  read: on TPU this raises; under some backends it silently reads
+  stale memory.
+- ``donate-materialize`` — a class whose attribute is passed as a
+  donated operand (the job carry) materialises that attribute
+  mid-job: ``int()``/``float()``/``list()``/``tuple()`` over it,
+  ``np.asarray``/``np.array``/``jnp.asarray`` of it, iteration over
+  it (incl. comprehensions), or ``.block_until_ready()``.  Each
+  materialisation is a full device sync — the exact stall the hot
+  plane exists to avoid.  The sanctioned job-end single fetch is
+  annotated ``# donate-ok: <reason>``.
+
+Donated callables are recognised two ways: a literal
+``jax.jit(..., donate_argnums=...)`` binding (the argnums literal is
+read), and a binding from a hot-step factory (any callee whose name
+contains ``hot_step`` — the repo convention, ``donate_argnums=(0,)``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .common import (
+    DONATE_OK_RE,
+    Finding,
+    comment_in_span,
+    file_comments,
+    iter_py_files,
+    rel,
+    walk_shallow,
+)
+
+PASS = "donate"
+
+#: Donation discipline only binds on the device plane; scanning the whole
+#: tree would tax test helpers that never see a donated buffer.
+DONATE_SCAN_DIRS = ("bitcoin_miner_tpu/ops", "bitcoin_miner_tpu/parallel")
+
+#: The hot-step factory convention: any callee spelled like one returns a
+#: jitted step donating its first argument (the carry).
+HOT_FACTORY_RE = re.compile(r"hot_step")
+
+_MATERIALIZE_NAMES = {"int", "float", "list", "tuple"}
+_MATERIALIZE_ATTRS = {"asarray", "array"}
+
+
+def _dotted(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _jit_donate_argnums(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """argnums of a literal ``jax.jit(..., donate_argnums=...)`` call, or
+    None if this is not one (or the literal cannot be read)."""
+    d = _dotted(call.func)
+    if d is None or d[-1] != "jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                    return None
+                out.append(e.value)
+            return tuple(out)
+        return None
+    return None
+
+
+def _donated_argnums_of(value: ast.AST) -> Optional[Tuple[int, ...]]:
+    """argnums when ``value`` builds a donated callable, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    nums = _jit_donate_argnums(value)
+    if nums is not None:
+        return nums
+    d = _dotted(value.func)
+    if d is not None and HOT_FACTORY_RE.search(d[-1]):
+        return (0,)
+    return None
+
+
+def _contains(expr: ast.AST, dotted: Tuple[str, ...]) -> bool:
+    return any(_dotted(n) == dotted for n in ast.walk(expr))
+
+
+def _flat_targets(targets: Sequence[ast.AST]) -> List[Tuple[str, ...]]:
+    out: List[Tuple[str, ...]] = []
+    stack = list(targets)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        else:
+            d = _dotted(t)
+            if d is not None:
+                out.append(d)
+    return out
+
+
+class _FileChecker:
+    def __init__(self, path: str, source: str, findings: List[Finding]) -> None:
+        self.path = path
+        self.comments = file_comments(source)
+        self.findings = findings
+        self.tree = ast.parse(source)
+        self.donated: Dict[Tuple[str, ...], Tuple[int, ...]] = {}
+        # Every binding of a donated callable, wherever it happens
+        # (module level, __init__, the dispatch body) — an over-approx
+        # keyed by the bound name's dotted spelling.
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign):
+                nums = _donated_argnums_of(node.value)
+                if nums is None:
+                    continue
+                for d in _flat_targets(node.targets):
+                    self.donated[d] = nums
+
+    def _emit(self, rule: str, node: ast.AST, symbol: str, msg: str) -> None:
+        self.findings.append(
+            Finding(PASS, rule, self.path, node.lineno, symbol, msg)
+        )
+
+    def _ok(self, stmt: ast.stmt) -> bool:
+        return (
+            comment_in_span(
+                self.comments, stmt.lineno,
+                getattr(stmt, "end_lineno", None), DONATE_OK_RE,
+            )
+            is not None
+        )
+
+    # ------------------------------------------------------ linear suites
+
+    def _donated_call_in(self, stmt: ast.stmt) -> Optional[Tuple[ast.Call, Tuple[int, ...]]]:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d is not None and d in self.donated:
+                    return node, self.donated[d]
+        return None
+
+    def _check_suite(self, symbol: str, suite: Sequence[ast.stmt]) -> None:
+        for i, stmt in enumerate(suite):
+            hit = None
+            if isinstance(stmt, (ast.Assign, ast.Expr, ast.AugAssign, ast.AnnAssign)):
+                hit = self._donated_call_in(stmt)
+            if hit is None:
+                continue
+            call, nums = hit
+            operands = [
+                _dotted(call.args[n])
+                for n in nums
+                if n < len(call.args)
+            ]
+            operands = [o for o in operands if o is not None]
+            if not operands:
+                continue
+            rebound = (
+                _flat_targets(stmt.targets)
+                if isinstance(stmt, ast.Assign)
+                else []
+            )
+            for op in operands:
+                spelled = ".".join(op)
+                if op not in rebound:
+                    if not self._ok(stmt):
+                        self._emit(
+                            "donate-no-rebind", call, symbol,
+                            f"donated call does not rebind {spelled} — the "
+                            f"operand's buffer is dead after this call; "
+                            f"assign the result back "
+                            f"({spelled}, ... = step({spelled}, ...))",
+                        )
+                    continue  # unrebound: read-after is the same finding
+                # Rebound at the call: scan the rest of the suite for a
+                # read BEFORE any further rebind (dead-handle window is
+                # closed here, but a sibling alias read is still wrong
+                # for a second donated call; keep it linear and local).
+            for later in suite[i + 1:]:
+                if any(op in _flat_targets(later.targets) for op in operands) if isinstance(later, ast.Assign) else False:
+                    break
+                for op in operands:
+                    if op in rebound:
+                        continue
+                    if any(
+                        _contains(e, op)
+                        for e in ast.walk(later)
+                        if isinstance(e, ast.expr)
+                    ) and not self._ok(later):
+                        self._emit(
+                            "donate-read-after-call", later, symbol,
+                            f"{'.'.join(op)} read after being donated — "
+                            f"its device buffer was reused by the donated "
+                            f"call above; rebind it from the call result "
+                            f"before any further use",
+                        )
+                        break
+                else:
+                    continue
+                break
+
+    def _check_functions(self) -> None:
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    name = f"{prefix}.{child.name}" if prefix else child.name
+                    for suite in self._suites(child):
+                        self._check_suite(name, suite)
+                    visit(child, name)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                else:
+                    visit(child, prefix)
+
+        visit(self.tree, "")
+
+    @staticmethod
+    def _suites(fn: ast.AST) -> List[Sequence[ast.stmt]]:
+        out: List[Sequence[ast.stmt]] = [fn.body] if getattr(fn, "body", None) else []
+        for node in walk_shallow(fn):
+            for field in ("body", "orelse", "finalbody"):
+                suite = getattr(node, field, None)
+                if isinstance(suite, list) and suite and isinstance(suite[0], ast.stmt):
+                    out.append(suite)
+        return out
+
+    # -------------------------------------------------- carry materialise
+
+    def _carry_attrs(self, cls: ast.ClassDef) -> Set[str]:
+        """Attributes of ``cls`` ever passed as a donated operand."""
+        out: Set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None or d not in self.donated:
+                continue
+            for n in self.donated[d]:
+                if n < len(node.args):
+                    od = _dotted(node.args[n])
+                    if od is not None and len(od) == 2 and od[0] == "self":
+                        out.add(od[1])
+        return out
+
+    def _check_materialize(self) -> None:
+        for cls in ast.walk(self.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            carries = self._carry_attrs(cls)
+            if not carries:
+                continue
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                symbol = f"{cls.name}.{method.name}"
+                for attr in carries:
+                    self._check_method_materialize(symbol, method, attr)
+
+    def _check_method_materialize(
+        self, symbol: str, method: ast.AST, attr: str
+    ) -> None:
+        carry = ("self", attr)
+
+        def emit(node: ast.AST, how: str) -> None:
+            stmt = _stmt_of(method, node)
+            if stmt is not None and self._ok(stmt):
+                return
+            self._emit(
+                "donate-materialize", node, symbol,
+                f"self.{attr} is a donated job carry — {how} is a full "
+                f"device sync mid-job; carry reads belong at job end "
+                f"(annotate the sanctioned fetch with # donate-ok:)",
+            )
+
+        for node in ast.walk(method):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Name)
+                    and f.id in _MATERIALIZE_NAMES
+                    and any(_contains(a, carry) for a in node.args)
+                ):
+                    emit(node, f"{f.id}() over it")
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _MATERIALIZE_ATTRS
+                    and any(_contains(a, carry) for a in node.args)
+                ):
+                    emit(node, f".{f.attr}() of it")
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "block_until_ready"
+                    and _contains(f.value, carry)
+                ):
+                    emit(node, ".block_until_ready() on it")
+            elif isinstance(node, ast.For) and _contains(node.iter, carry):
+                emit(node, "iterating it")
+            elif isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp, ast.DictComp)):
+                if any(_contains(g.iter, carry) for g in node.generators):
+                    emit(node, "iterating it")
+
+    def check(self) -> None:
+        if not self.donated:
+            return
+        self._check_functions()
+        self._check_materialize()
+
+
+def _stmt_of(fn: ast.AST, target: ast.AST) -> Optional[ast.stmt]:
+    best: Optional[ast.stmt] = None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.stmt) and target in ast.walk(node):
+            if best is None or node.lineno >= best.lineno:
+                best = node
+    return best
+
+
+def run(root: Path, scan_dirs: Optional[Tuple[str, ...]] = None) -> List[Finding]:
+    """``scan_dirs=None`` scans the whole tree (fixture mode); repo mode
+    passes :data:`DONATE_SCAN_DIRS` (see __main__.py)."""
+    findings: List[Finding] = []
+    for path in iter_py_files(root, scan_dirs):
+        try:
+            source = path.read_text()
+            checker = _FileChecker(rel(path, root), source, findings)
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+        checker.check()
+    return findings

@@ -1,7 +1,20 @@
 """``python -m tools.analyze lockcheck --fix`` — the mechanical lock fixer.
 
 The lock pass (lockcheck.py) FINDS unguarded accesses; this mode fixes
-the subset a machine can fix safely and shows its work for the rest:
+the subset a machine can fix safely and shows its work for the rest.
+Since ISSUE 19 it also repairs loopcheck's ``loop-off-thread-write``
+findings: a bare fire-and-forget call on a loop-owned field
+(``self._server.write(conn, payload)``) rewrites to the threadsafe hop
+the finding message spells (``self._loop.call_soon_threadsafe(
+self._server.write, conn, payload)``) — but only when the statement is
+a simple expression with plain-name/attribute/constant arguments, no
+keywords, and no return-value use.  Anything else (an assignment that
+needs the result, starred/keyword args, compound headers, closures)
+gets a review block: a fire-and-forget hop cannot return a value or
+evaluate rich argument expressions at hop time without changing
+semantics.
+
+For the lock rules proper:
 
 - **Safe to wrap**: the flagged access sits in a SIMPLE statement — an
   expression, assignment, augmented assignment or ``return`` occupying
@@ -33,13 +46,25 @@ import ast
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
-from . import lockcheck
+import re
+
+from . import lockcheck, loopcheck
 from .common import Finding, iter_py_files, rel
 
 #: Statement types a machine may wrap: single-suite-slot, no control
 #: flow of their own — moving them under a ``with`` cannot change what
 #: executes, only what lock is held while it does.
 _SIMPLE_STMTS = (ast.Expr, ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Return)
+
+#: loopcheck's message spelling is this module's parse contract (same
+#: deal as the lock markers below).
+_HOP_RE = re.compile(
+    r"hop via self\.(\w+)\.call_soon_threadsafe\(self\.(\w+)\.(\w+), "
+)
+
+#: Argument shapes the hop rewrite may carry over verbatim: evaluated at
+#: call-schedule time either way, no observable reorder.
+_SIMPLE_ARGS = (ast.Name, ast.Attribute, ast.Constant)
 
 
 def _lock_spelling(symbol: str, lock: str) -> str:
@@ -134,10 +159,13 @@ def fix(
     """Run the lock pass, apply every safe fix in place, and return
     ``(fixed_count, review_diffs)`` — the diffs are the annotated
     not-safe findings a human must place by hand."""
-    findings = lockcheck.run(root, scan_dirs)
+    findings = lockcheck.run(root, scan_dirs) + loopcheck.run(root, scan_dirs)
     by_file: Dict[str, List[Finding]] = {}
     for f in findings:
-        if f.rule in ("field-off-lock", "helper-off-lock", "local-off-lock"):
+        if f.rule in (
+            "field-off-lock", "helper-off-lock", "local-off-lock",
+            "loop-off-thread-write",
+        ):
             by_file.setdefault(f.path, []).append(f)
     fixed = 0
     reviews: List[str] = []
@@ -154,6 +182,11 @@ def fix(
             continue  # the lock pass already reported it
         index = _StmtIndex()
         index.visit(tree)
+        # Plan every edit as (start0, end0, replacement lines) and apply
+        # the whole batch bottom-up at the end, so the hop rewrites and
+        # the lock wraps cannot shift each other's line numbers.
+        edits: List[Tuple[int, int, List[str]]] = []
+        flist = _plan_hops(flist, index, lines, reviews, edits)
         # Group findings by their enclosing simple statement; a finding
         # with no simple statement (or on an unsafe line) needs review.
         per_stmt: Dict[int, Tuple[ast.stmt, str]] = {}
@@ -178,14 +211,16 @@ def fix(
                 per_stmt.pop(key, None)
                 continue
             per_stmt[key] = (stmt, ref)
-        if not per_stmt:
+        for _, (stmt, ref) in per_stmt.items():
+            start = stmt.lineno - 1
+            end = getattr(stmt, "end_lineno", stmt.lineno) - 1
+            edits.append((start, end, _wrap(lines, stmt, ref)))
+        if not edits:
             continue
         # Apply bottom-up so earlier line numbers stay valid.
         new_lines = list(lines)
-        for _, (stmt, ref) in sorted(per_stmt.items(), reverse=True):
-            start = stmt.lineno - 1
-            end = getattr(stmt, "end_lineno", stmt.lineno) - 1
-            new_lines[start:end + 1] = _wrap(lines, stmt, ref)
+        for start, end, repl in sorted(edits, reverse=True):
+            new_lines[start:end + 1] = repl
             fixed += 1
         if write:
             path.write_text(
@@ -196,6 +231,75 @@ def fix(
                 _diff(rpath, lines, new_lines, "proposed (dry run)")
             )
     return fixed, reviews
+
+
+def _plan_hops(
+    flist: List[Finding],
+    index: "_StmtIndex",
+    lines: List[str],
+    reviews: List[str],
+    edits: List[Tuple[int, int, List[str]]],
+) -> List[Finding]:
+    """Plan the ``loop-off-thread-write`` rewrites; returns the findings
+    the lock rules should still consider (everything else)."""
+    rest: List[Finding] = []
+    for f in flist:
+        if f.rule != "loop-off-thread-write":
+            rest.append(f)
+            continue
+        m = _HOP_RE.search(f.message)
+        stmt = index.simple.get(f.line)
+        if (
+            m is None
+            or stmt is None
+            or not isinstance(stmt, ast.Expr)
+            or f.line in index.unsafe_lines
+        ):
+            reviews.append(_hop_review_entry(f, lines))
+            continue
+        loopattr, field, meth = m.groups()
+        call = stmt.value
+        if not (
+            isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr == meth
+            and isinstance(call.func.value, ast.Attribute)
+            and call.func.value.attr == field
+            and isinstance(call.func.value.value, ast.Name)
+            and call.func.value.value.id == "self"
+            and not call.keywords
+            and all(isinstance(a, _SIMPLE_ARGS) for a in call.args)
+        ):
+            reviews.append(_hop_review_entry(f, lines))
+            continue
+        start = stmt.lineno - 1
+        end = getattr(stmt, "end_lineno", stmt.lineno) - 1
+        head = lines[start]
+        indent = head[: len(head) - len(head.lstrip())]
+        args = "".join(f", {ast.unparse(a)}" for a in call.args)
+        edits.append((start, end, [
+            f"{indent}self.{loopattr}.call_soon_threadsafe("
+            f"self.{field}.{meth}{args})"
+        ]))
+    return rest
+
+
+def _hop_review_entry(f: Finding, lines: List[str]) -> str:
+    """An annotated context block for an off-loop write the fixer
+    refuses to hop mechanically."""
+    at = f.line - 1
+    lo, hi = max(0, at - 2), min(len(lines), at + 3)
+    ctx = "\n".join(
+        f"{'>' if i == at else ' '} {i + 1:4d} {lines[i]}"
+        for i in range(lo, hi)
+    )
+    return (
+        f"# lockcheck --fix: NOT auto-hoppable — {f.path}:{f.line} "
+        f"{f.symbol} writes a loop-owned field but needs its return "
+        f"value, rich argument expressions, or sits in a compound "
+        f"header/closure; a fire-and-forget call_soon_threadsafe hop "
+        f"would change semantics.  Hop it by hand:\n{ctx}\n"
+    )
 
 
 def _has_lock_machinery(stmt: ast.stmt) -> bool:

@@ -1,0 +1,337 @@
+"""Pass 7: the asyncio loop-discipline lint (ISSUE 19).
+
+The serving hot paths live on event loops now — the ``AsyncIngress``
+read loop, the LSP sync facades' private loops, the federation cell's
+one shared fed-port/gossip/forwarder loop — and ONE blocking call on a
+loop stalls every conn riding it.  The static half of that contract:
+
+**On-loop code** is any ``async def`` (a coroutine body always runs on
+its loop) plus any plain ``def`` whose header carries ``# on-loop:``
+(the ``_LoopBridge`` hop targets, ``call_soon_threadsafe`` callbacks,
+``_LoopThread`` bodies).  Nested defs inside on-loop code are on-loop
+too (they are loop-side closures).  Rules, suppressed per statement
+with ``# loop-ok: <reason>``:
+
+- ``loop-blocking-call`` — a blocking primitive in on-loop code:
+  ``time.sleep``, ``open()``, or a non-awaited ``.result()`` /
+  ``.acquire()`` / ``.read()``/``.readline()`` / ``.recv()`` call (the
+  Future-wait / lock-wait / file- and socket-I/O signatures).
+- ``loop-lock`` — a synchronous ``with <lock>:`` in on-loop code (any
+  context expression spelled like a lock: a name or attribute containing
+  ``lock`` / ``_mu``).  The event plane takes the event lock on the
+  ingress loop BY DESIGN — that path is a plain method reached through
+  the read loop, not an annotated/async body, so it is out of scope
+  here; the runtime detector (utils/sanitize.py) guards it with the
+  lock->loop edge query instead of a blanket ban.
+- ``loop-off-thread-write`` — a class field declared loop-owned
+  (``# on-loop: <loopattr>`` on its ``self.<field> = ...`` assignment)
+  is called/mutated from a method that is NOT on-loop, outside the
+  ``threading.current_thread() is self.<attr>`` identity fast path and
+  outside a ``call_soon_threadsafe``/``run_coroutine_threadsafe`` hop.
+  The finding message spells the fix (``hop via
+  self.<loopattr>.call_soon_threadsafe(...)``) — lockfix.py's
+  ``--fix`` mode parses that spelling to auto-wrap the simple cases.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import (
+    LOOP_OK_RE,
+    ON_LOOP_RE,
+    Finding,
+    comment_in_span,
+    file_comments,
+    iter_py_files,
+    rel,
+    walk_shallow,
+)
+
+PASS = "loop"
+
+#: Attribute-call names that block the calling thread (the Future-wait /
+#: lock-wait / file- and socket-I/O signatures).  ``.join`` is NOT here:
+#: ``str.join`` is everywhere and a statically-typed receiver is beyond
+#: a lint — thread joins on a loop surface via ``sanitize.blocking``.
+_BLOCKING_ATTRS = {"result", "acquire", "read", "readline", "readlines",
+                   "recv", "recv_into", "accept"}
+
+_HOP_CALLS = {"call_soon_threadsafe", "run_coroutine_threadsafe"}
+
+
+def _dotted(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _header_match(comments: Dict[int, str], fn: ast.AST, pattern) -> Optional[object]:
+    """A pattern match on the function HEADER only (def line through the
+    line before the first body statement) — body comments must not mark
+    the whole function."""
+    first_body = fn.body[0].lineno if getattr(fn, "body", None) else fn.lineno
+    return comment_in_span(comments, fn.lineno, max(fn.lineno, first_body - 1), pattern)
+
+
+def _ok(comments: Dict[int, str], stmt: ast.AST) -> bool:
+    return (
+        comment_in_span(
+            comments, stmt.lineno, getattr(stmt, "end_lineno", None), LOOP_OK_RE
+        )
+        is not None
+    )
+
+
+def _lockish(expr: ast.AST) -> bool:
+    """A context expression spelled like a lock: ``self._lock``,
+    ``lock``, ``self._mu``, ``self._prewarm_lock`` ..."""
+    d = _dotted(expr)
+    if d is None:
+        return False
+    leaf = d[-1].lower()
+    return "lock" in leaf or leaf in ("_mu", "mu")
+
+
+def _awaited_calls(fn: ast.AST) -> Set[ast.Call]:
+    """Call nodes that sit directly under an ``await`` (or inside one's
+    argument chain of asyncio.wait_for-style wrappers) — they yield, not
+    block."""
+    out: Set[ast.Call] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Await):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    out.add(sub)
+    return out
+
+
+class _FileChecker:
+    def __init__(
+        self, path: str, source: str, findings: List[Finding]
+    ) -> None:
+        self.path = path
+        self.comments = file_comments(source)
+        self.findings = findings
+        self.tree = ast.parse(source)
+
+    def _emit(self, rule: str, node: ast.AST, symbol: str, msg: str) -> None:
+        self.findings.append(
+            Finding(PASS, rule, self.path, node.lineno, symbol, msg)
+        )
+
+    # ------------------------------------------------------- on-loop bodies
+
+    def _on_loop_functions(self) -> List[Tuple[str, ast.AST]]:
+        """(symbol, fn) for every on-loop function: async defs, annotated
+        defs, and their nested defs."""
+        out: List[Tuple[str, ast.AST]] = []
+
+        def visit(node: ast.AST, prefix: str, inherited: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    name = f"{prefix}.{child.name}" if prefix else child.name
+                    on_loop = (
+                        inherited
+                        or isinstance(child, ast.AsyncFunctionDef)
+                        or _header_match(self.comments, child, ON_LOOP_RE)
+                        is not None
+                    )
+                    if on_loop:
+                        out.append((name, child))
+                    visit(child, name, on_loop)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, child.name, False)
+                else:
+                    visit(child, prefix, inherited)
+
+        visit(self.tree, "", False)
+        return out
+
+    def _check_body(self, symbol: str, fn: ast.AST) -> None:
+        awaited = _awaited_calls(fn)
+        for stmt in walk_shallow(fn):
+            # nested defs are checked under their own symbol (walk_shallow
+            # does not descend into them)
+            if not isinstance(stmt, ast.stmt) or self._ok_stmt(stmt):
+                continue
+            if isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    if _lockish(item.context_expr):
+                        self._emit(
+                            "loop-lock",
+                            stmt,
+                            symbol,
+                            "synchronous lock taken in on-loop code — a "
+                            "contended acquire stalls every conn on the "
+                            "loop; move the locked work off-loop or use "
+                            "the call_soon_threadsafe hop",
+                        )
+            for node in self._own_exprs(stmt):
+                for call in ast.walk(node):
+                    if not isinstance(call, ast.Call) or call in awaited:
+                        continue
+                    d = _dotted(call.func)
+                    if d == ("time", "sleep"):
+                        self._emit(
+                            "loop-blocking-call", call, symbol,
+                            "time.sleep() in on-loop code — use "
+                            "asyncio.sleep (or move the wait off-loop)",
+                        )
+                    elif isinstance(call.func, ast.Name) and call.func.id == "open":
+                        self._emit(
+                            "loop-blocking-call", call, symbol,
+                            "file I/O (open) in on-loop code blocks the "
+                            "loop for the whole syscall",
+                        )
+                    elif (
+                        isinstance(call.func, ast.Attribute)
+                        and call.func.attr in _BLOCKING_ATTRS
+                    ):
+                        self._emit(
+                            "loop-blocking-call", call, symbol,
+                            f".{call.func.attr}() in on-loop code is a "
+                            "blocking wait — await the async spelling or "
+                            "hop the work off the loop",
+                        )
+
+    def _ok_stmt(self, stmt: ast.stmt) -> bool:
+        return _ok(self.comments, stmt)
+
+    @staticmethod
+    def _own_exprs(stmt: ast.stmt) -> List[ast.AST]:
+        """The statement's own expressions (compound statements contribute
+        their headers; their suites re-enter via the ast.walk over fn)."""
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return [stmt.iter]
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return [i.context_expr for i in stmt.items]
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Try)):
+            return []
+        return [stmt]
+
+    # ------------------------------------------------- loop-owned fields
+
+    def _check_loop_owned_fields(self) -> None:
+        for cls in ast.walk(self.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            owned: Dict[str, str] = {}  # field -> loop attr
+            for stmt in ast.walk(cls):
+                if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                    continue
+                t = stmt.targets[0]
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    m = comment_in_span(
+                        self.comments, stmt.lineno,
+                        getattr(stmt, "end_lineno", None), ON_LOOP_RE,
+                    )
+                    if m is not None:
+                        owned[t.attr] = m.group(1) or "_loop"
+            if not owned:
+                continue
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(method, ast.AsyncFunctionDef):
+                    continue  # on-loop by definition
+                if method.name == "__init__":
+                    continue  # construction happens on the loop
+                if _header_match(self.comments, method, ON_LOOP_RE) is not None:
+                    continue
+                self._check_off_thread_writes(cls.name, method, owned)
+
+    def _check_off_thread_writes(
+        self, cls_name: str, method: ast.FunctionDef, owned: Dict[str, str]
+    ) -> None:
+        guarded = self._identity_guarded_nodes(method)
+        for node in walk_shallow(method):
+            if not isinstance(node, ast.Call) or node in guarded:
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "self"
+                and f.value.attr in owned
+            ):
+                continue
+            stmt = self._stmt_of(method, node)
+            if stmt is not None and self._ok_stmt(stmt):
+                continue
+            field, meth = f.value.attr, f.attr
+            loopattr = owned[field]
+            self._emit(
+                "loop-off-thread-write",
+                node,
+                f"{cls_name}.{method.name}",
+                f"call on loop-owned field self.{field} off the loop "
+                f"thread — hop via self.{loopattr}.call_soon_threadsafe"
+                f"(self.{field}.{meth}, ...)",
+            )
+
+    @staticmethod
+    def _stmt_of(fn: ast.AST, target: ast.AST) -> Optional[ast.stmt]:
+        """The innermost statement containing ``target``."""
+        best: Optional[ast.stmt] = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.stmt) and target in ast.walk(node):
+                if best is None or node.lineno >= best.lineno:
+                    best = node
+        return best
+
+    @staticmethod
+    def _identity_guarded_nodes(method: ast.FunctionDef) -> Set[ast.AST]:
+        """Nodes inside (a) the body of a thread-identity fast path
+        (``if threading.current_thread() is self.<attr>:``) or (b) the
+        arguments of a threadsafe hop call — both are the sanctioned
+        spellings, not violations."""
+        out: Set[ast.AST] = set()
+        for node in ast.walk(method):
+            if isinstance(node, ast.If):
+                has_identity = any(
+                    isinstance(c, ast.Call)
+                    and (d := _dotted(c.func)) is not None
+                    and d[-1] == "current_thread"
+                    for c in ast.walk(node.test)
+                )
+                if has_identity:
+                    for stmt in node.body:
+                        out.update(ast.walk(stmt))
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d is not None and d[-1] in _HOP_CALLS:
+                    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                        out.update(ast.walk(arg))
+        return out
+
+    def check(self) -> None:
+        for symbol, fn in self._on_loop_functions():
+            self._check_body(symbol, fn)
+        self._check_loop_owned_fields()
+
+
+def run(root: Path, scan_dirs: Optional[Tuple[str, ...]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(root, scan_dirs):
+        try:
+            source = path.read_text()
+            checker = _FileChecker(rel(path, root), source, findings)
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+        checker.check()
+    return findings

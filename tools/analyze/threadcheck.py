@@ -1,0 +1,255 @@
+"""Pass 9: the thread-lifecycle sanitizer's static half (ISSUE 19).
+
+Every ``threading.Thread(...)`` construction must have an owner that
+reaps it, or say who does:
+
+- ``thread-unjoined`` (class-owned) — a thread stored on the instance
+  (``self._t = Thread(...)`` or appended to a ``self.<list>``) must be
+  joined on the class's reaper path: a method named (or prefixed)
+  ``close`` / ``stop`` / ``shutdown`` that calls ``self.<attr>.join()``
+  or for-loops over ``self.<list>`` joining each element.  Daemon
+  status does NOT exempt a class-owned thread: a daemon worker left
+  running after close() still holds the object alive and still shows
+  up in the leak census — the process exiting is not a lifecycle.
+- ``thread-unjoined`` (function-local) — a fire-and-forget thread built
+  in a function body is clean when it is a daemon or when the enclosing
+  function joins (any ``.join()`` call in the function counts — the
+  wait-for-workers idiom).  A non-daemon local thread nobody joins
+  leaks a shutdown hang.
+
+Both spellings accept ``# thread-owner: <owner.close>`` on the
+construction statement, naming the out-of-band reaper (the tiered
+watchdog deliberately abandons a wedged tier's thread, for example).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional, Set, Tuple
+
+from .common import (
+    THREAD_OWNER_RE,
+    Finding,
+    comment_in_span,
+    file_comments,
+    iter_py_files,
+    rel,
+    walk_shallow,
+)
+
+PASS = "thread"
+
+_REAPER_PREFIXES = ("close", "stop", "shutdown")
+
+
+def _dotted(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    return d is not None and d[-1] == "Thread"
+
+
+def _is_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            return isinstance(kw.value, ast.Constant) and kw.value.value is True
+    return False
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _FileChecker:
+    def __init__(self, path: str, source: str, findings: List[Finding]) -> None:
+        self.path = path
+        self.comments = file_comments(source)
+        self.findings = findings
+        self.tree = ast.parse(source)
+
+    def _emit(self, node: ast.AST, symbol: str, msg: str) -> None:
+        self.findings.append(
+            Finding(PASS, "thread-unjoined", self.path, node.lineno, symbol, msg)
+        )
+
+    def _owned(self, stmt: ast.stmt) -> bool:
+        return (
+            comment_in_span(
+                self.comments, stmt.lineno,
+                getattr(stmt, "end_lineno", None), THREAD_OWNER_RE,
+            )
+            is not None
+        )
+
+    # ------------------------------------------------------------- classes
+
+    def _check_class(self, cls: ast.ClassDef) -> None:
+        # attr -> (construction stmt, method name) for class-owned threads
+        owned: List[Tuple[str, ast.stmt, str]] = []
+        handled_ctors: Set[ast.Call] = set()
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # local name -> ctor call, for the append-to-self-list idiom
+            locals_: dict = {}
+            for stmt in ast.walk(method):
+                if isinstance(stmt, ast.Assign) and _is_thread_ctor(stmt.value):
+                    handled = False
+                    for t in stmt.targets:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            owned.append((attr, stmt, method.name))
+                            handled = True
+                        elif isinstance(t, ast.Name):
+                            locals_[t.id] = (stmt, stmt.value)
+                    if handled:
+                        handled_ctors.add(stmt.value)
+                elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                    # self.<list>.append(t) promotes local t to class-owned
+                    call = stmt.value
+                    f = call.func
+                    if (
+                        isinstance(f, ast.Attribute)
+                        and f.attr == "append"
+                        and (attr := _self_attr(f.value)) is not None
+                        and len(call.args) == 1
+                        and isinstance(call.args[0], ast.Name)
+                        and call.args[0].id in locals_
+                    ):
+                        ctor_stmt, ctor = locals_.pop(call.args[0].id)
+                        owned.append((attr, ctor_stmt, method.name))
+                        handled_ctors.add(ctor)
+        joined = self._reaper_joined_attrs(cls)
+        for attr, stmt, method_name in owned:
+            if attr in joined or self._owned(stmt):
+                continue
+            self._emit(
+                stmt, f"{cls.name}.{method_name}",
+                f"class-owned thread self.{attr} is never joined on a "
+                f"close()/stop()/shutdown() path — join it in the reaper "
+                f"or name its owner with # thread-owner:",
+            )
+        self._handled.update(handled_ctors)
+
+    def _reaper_joined_attrs(self, cls: ast.ClassDef) -> Set[str]:
+        """self-attrs joined on some reaper method of ``cls``."""
+        out: Set[str] = set()
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not method.name.lstrip("_").startswith(_REAPER_PREFIXES):
+                continue
+            # for t in self.<list>: ... t.join() — map loop vars back
+            loop_vars: dict = {}
+            for node in ast.walk(method):
+                if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+                    attr = _self_attr(node.iter)
+                    if attr is not None:
+                        loop_vars[node.target.id] = attr
+            for node in ast.walk(method):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"
+                ):
+                    continue
+                recv = node.func.value
+                attr = _self_attr(recv)
+                if attr is not None:
+                    out.add(attr)
+                elif isinstance(recv, ast.Name) and recv.id in loop_vars:
+                    out.add(loop_vars[recv.id])
+        return out
+
+    # ----------------------------------------------------- function-local
+
+    def _check_function_local(self) -> None:
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    name = f"{prefix}.{child.name}" if prefix else child.name
+                    self._check_one_function(name, child)
+                    visit(child, name)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                else:
+                    visit(child, prefix)
+
+        visit(self.tree, "")
+
+    def _check_one_function(self, symbol: str, fn: ast.AST) -> None:
+        # walk_shallow keeps nested defs' threads attributed to the
+        # nested symbol, never double-reported under the outer one
+        ctors: List[ast.Call] = []
+        has_join = False
+        for node in walk_shallow(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+            ):
+                has_join = True
+            if (
+                _is_thread_ctor(node)
+                and node not in self._handled
+                and not _is_daemon(node)
+            ):
+                ctors.append(node)
+        if has_join:
+            return
+        for call in ctors:
+            stmt = self._stmt_of(fn, call)
+            if stmt is not None and self._owned(stmt):
+                continue
+            self._emit(
+                stmt if stmt is not None else call, symbol,
+                "non-daemon thread is never joined — the process cannot "
+                "exit while it runs; join it, make it a daemon with an "
+                "owner, or name its reaper with # thread-owner:",
+            )
+
+    @staticmethod
+    def _stmt_of(fn: ast.AST, target: ast.AST) -> Optional[ast.stmt]:
+        best: Optional[ast.stmt] = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.stmt) and target in ast.walk(node):
+                if best is None or node.lineno >= best.lineno:
+                    best = node
+        return best
+
+    def check(self) -> None:
+        self._handled: Set[ast.Call] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_class(node)
+        self._check_function_local()
+
+
+def run(root: Path, scan_dirs: Optional[Tuple[str, ...]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(root, scan_dirs):
+        try:
+            source = path.read_text()
+            checker = _FileChecker(rel(path, root), source, findings)
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+        checker.check()
+    return findings
