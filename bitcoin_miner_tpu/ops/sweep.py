@@ -424,30 +424,38 @@ def _make_kernel(
 
 
 @lru_cache(maxsize=256)
-def _layout_cache(data: bytes, d: int, sep: bytes = b" ") -> MsgLayout:
+def _layout_cache(data: bytes, d: int, sep: bytes = b" ", family: str = "sha256"):
+    if family == "blake2b":
+        from .blake2b import build_layout as build_blake2b_layout
+
+        return build_blake2b_layout(data, d, sep=sep)
     return build_layout(data, d, sep=sep)
 
 
-def _workload_knobs(workload) -> Tuple[bytes, object, bool]:
-    """Resolve the (separator, host-min fn, native-allowed) triple a
-    sweep driver needs from a workload object (duck-typed: ``.sep``,
-    ``._cpu_search``, ``.native_ok`` — see workloads/base.py).  ``None``
-    means the frozen mining default, byte-identical to the pre-registry
-    behavior.  A workload without a SHA-256 message template cannot run
-    these drivers at all — that is a configuration error, not a silent
-    wrong answer."""
+def _workload_knobs(workload) -> Tuple[bytes, object, bool, str]:
+    """Resolve the (separator, host-min fn, native-allowed, kernel
+    family) tuple a sweep driver needs from a workload object
+    (duck-typed: ``.sep``, ``._cpu_search``, ``.native_ok``,
+    ``.kernel_family`` — see workloads/base.py).  ``None`` means the
+    frozen mining default, byte-identical to the pre-registry behavior.
+    The kernel family picks which message-layout builder + device kernel
+    the drivers compile ("sha256" or "blake2b"); a workload with neither
+    template cannot run these drivers at all — that is a configuration
+    error, not a silent wrong answer."""
     if workload is None:
-        return b" ", _host_min, True
+        return b" ", _host_min, True, "sha256"
     if getattr(workload, "sep", None) is None:
         raise ValueError(
             f"workload {getattr(workload, 'name', workload)!r} has no "
-            "SHA-256 message template; its tier ladder has no device tier"
+            "device message template; its tier ladder has no device tier"
         )
+    family = getattr(workload, "kernel_family", "sha256")
     if getattr(workload, "native_ok", False):
-        return workload.sep, _host_min, True  # native == this workload's oracle
+        # native == this workload's oracle
+        return workload.sep, _host_min, True, family
     # The workload's cpu-tier loop (prefix-folded, one encode per call),
     # not its per-nonce min_range oracle: host lanes sit on the hot path.
-    return workload.sep, workload._cpu_search(), False
+    return workload.sep, workload._cpu_search(), False, family
 
 
 def _fill_templates(
@@ -485,7 +493,17 @@ class SweepResult:
 
 
 def _default_backend() -> str:
-    return "pallas" if is_tpu() else "xla"
+    """The strongest tier this host's devices run by DEFAULT: pallas only
+    under the Mosaic (TPU) lowering.  A GPU host *has* a pallas lowering
+    (Triton — :func:`~bitcoin_miner_tpu.utils.platform.pallas_platform`
+    reports it, and ``backend="pallas"`` is honored there), but the rung
+    stays off by default until a GPU bench prices it: every pallas
+    default in :func:`auto_tune` (sieve ON, batch 1024, max_k 6) was
+    measured under Mosaic and none transfer sight-unseen to Triton's
+    warp-level cost model (ROADMAP follow-on)."""
+    from ..utils.platform import pallas_platform
+
+    return "pallas" if pallas_platform() == "mosaic" else "xla"
 
 
 def auto_tune(
@@ -495,11 +513,30 @@ def auto_tune(
     sieve: Optional[bool] = None,
     factored: Optional[bool] = None,
     hot: Optional[bool] = None,
+    family: str = "sha256",
 ) -> Tuple[str, int, int, bool, bool, bool]:
     """Resolve the (backend, rows-per-dispatch, max_k, sieve, factored,
     hot) defaults shared by the single-device and sharded sweep drivers.
     max_k=5 bounds the xla tier's compress_rolled schedule buffer
     ((16, B, 10^k) u32) to ~50 MB at B=8.
+
+    ``family`` resolves PER-WORKLOAD rung defaults (ISSUE 20) — the
+    tuple was sha256-template-only before the BLAKE2b device tier
+    landed.  The "blake2b" family has exactly one device rung, the
+    grouped-unrolled xla kernel (ops/blake2b.py): no pallas lowering
+    exists for it, so ``backend`` resolves to "xla" on every platform
+    (requesting "pallas" is a configuration error, same contract as a
+    workload without the tier); ``batch`` defaults to 8 (measured on
+    this host: 5.28M n/s at batch 8 / k_in 3 vs 4.82M at batch 4 /
+    k_in 4 — the BLAKE2b DAG is narrower than SHA-256's, so the
+    cache-residency knee sits at a wider batch); ``factored`` defaults
+    ON (the grouped form IS the kernel's production shape — the
+    full-lane form exists for tiny classes and tests); ``sieve``
+    defaults OFF (h0 and h1 fall out of one compression word, so there
+    is no cheaper pass 1 — the threshold operand exists for the hot
+    plane's carried bound, not as a two-stage win); ``hot`` defaults
+    OFF like the sha256 xla tier (same per-dispatch-cost argument,
+    BENCH_pr16.json).
 
     The **sieve rung** (ISSUE 13, ``sieve=None`` = auto): the two-stage
     sieve kernel is ON for the pallas tier — pass 1's predicate epilogue
@@ -553,6 +590,25 @@ def auto_tune(
     factored pallas rung).  A shape where the hot plane does not
     demonstrably win keeps the per-chunk kernel by default; the plane
     stays available behind ``hot=True`` and is bit-exact either way."""
+    if family == "blake2b":
+        if backend is None:
+            backend = "xla"
+        elif backend == "pallas":
+            raise ValueError(
+                "the blake2b kernel family has no pallas lowering; its "
+                "device rung is the xla grouped-unrolled kernel"
+            )
+        if batch is None:
+            batch = 8
+        if max_k is None:
+            max_k = 5
+        if sieve is None:
+            sieve = False
+        if factored is None:
+            factored = True
+        if hot is None:
+            hot = False
+        return backend, batch, max_k, sieve, factored, hot
     if backend is None:
         backend = _default_backend()
     if batch is None:
@@ -637,14 +693,16 @@ def run_sweep_dispatches(
     host_lane_budget: int = 0,
     sep: bytes = b" ",
     host_min=None,
+    family: str = "sha256",
 ) -> int:
     """The decompose → template-fill → dispatch skeleton shared by the
     single-device (below) and sharded (parallel/sweep.py) drivers.
 
-    ``sep``/``host_min`` are the workload knobs (``_workload_knobs``):
-    the message-template separator baked into each digit class's layout,
-    and the host-tier fold used for host-routed tiny classes (defaults =
-    the frozen mining workload).
+    ``sep``/``host_min``/``family`` are the workload knobs
+    (``_workload_knobs``): the message-template separator baked into
+    each digit class's layout, the host-tier fold used for host-routed
+    tiny classes, and the kernel family whose layout builder runs
+    (defaults = the frozen mining workload).
 
     ``get_kernel(layout, group)`` builds/caches the kernel for a shape class;
     ``run_kernel(kern, midstate, tail_const, bounds)`` queues one dispatch
@@ -674,7 +732,7 @@ def run_sweep_dispatches(
             pending.append((HostFold(h, n), None, None))
             lanes += sum(c.hi_off - c.lo_off for c in group.chunks)
             continue
-        layout = _layout_cache(data_bytes, group.d, sep)
+        layout = _layout_cache(data_bytes, group.d, sep, family)
         kern = get_kernel(layout, group)
         midstate = np.array(layout.midstate, dtype=np.uint32)
         for s in range(0, len(group.chunks), batch):
@@ -738,7 +796,22 @@ def _build_kernel(
     split).  The cost is per-class compiles again; SweepPipeline's
     prewarm machinery (digit-boundary speculation + single-flight build
     locks) already exists to hide exactly that.
+
+    Layouts carry their kernel family (``layout.family``): the blake2b
+    family resolves to its own grouped-unrolled xla kernel
+    (ops/blake2b.py) with the same operand/result contract, so every
+    caller of this function serves both families unchanged.
     """
+    if getattr(layout, "family", "sha256") == "blake2b":
+        if backend != "xla":
+            raise ValueError(
+                f"blake2b kernel family has no {backend!r} tier (xla only)"
+            )
+        from .blake2b import build_kernel_for
+
+        return build_kernel_for(
+            layout, group, batch, sieve=sieve, factored=factored
+        )
     low_pos = layout.digit_pos[layout.digit_count - group.k :]
     if backend == "pallas":
         if factored and group.k >= 2:
@@ -1164,10 +1237,13 @@ class SweepPipeline:
         from concurrent.futures import Future
 
         self._Future = Future
-        # Workload knobs (ISSUE 9): the message-template separator and
-        # the host fold for host-routed tiny digit classes.  None = the
-        # frozen mining default, byte-identical to the pre-registry path.
-        self._sep, self._host_min, native_ok = _workload_knobs(workload)
+        # Workload knobs (ISSUE 9/20): the message-template separator,
+        # the host fold for host-routed tiny digit classes, and the
+        # kernel family.  None = the frozen mining default,
+        # byte-identical to the pre-registry path.
+        (
+            self._sep, self._host_min, native_ok, self._family,
+        ) = _workload_knobs(workload)
         if mesh is not None and backend is None:
             # Resolve the backend from the MESH devices, not the process
             # default (same guard as sweep_min_hash_sharded: a CPU mesh in
@@ -1179,7 +1255,10 @@ class SweepPipeline:
         (
             self._backend, self._batch, self._max_k, self._sieve,
             self._factored, self._hot,
-        ) = auto_tune(backend, batch, max_k, sieve, factored, hot)
+        ) = auto_tune(
+            backend, batch, max_k, sieve, factored, hot,
+            family=self._family,
+        )
         if mesh is not None and self._backend == "pallas":
             # The sharded tier runs the PER-SHARD sieve (ISSUE 14
             # satellite) on both backends, and — since ISSUE 16 — the
@@ -1296,7 +1375,9 @@ class SweepPipeline:
         try:
             rep = 10 ** (d - 1)  # any nonce in the class: (d, k) is all
             group = next(decompose_range(rep, rep, max_k=self._max_k))
-            layout = _layout_cache(data.encode("utf-8"), group.d, self._sep)
+            layout = _layout_cache(
+                data.encode("utf-8"), group.d, self._sep, self._family
+            )
             kern = self._get_kernel(layout, group)
             midstate = np.array(layout.midstate, dtype=np.uint32)
             tail_const, bounds = _fill_templates(
@@ -1476,6 +1557,7 @@ class SweepPipeline:
                     host_lane_budget=self._host_lane_budget,
                     sep=self._sep,
                     host_min=self._host_min,
+                    family=self._family,
                 )
             except BaseException as e:  # resolve, don't kill the pipeline
                 self._fail(fut, e)
@@ -1626,11 +1708,11 @@ def sweep_min_hash(
     descriptor ring (:class:`_HotLoop`) — composable with both other
     rungs, bit-exact either way.
     """
+    sep, host_min, _native_ok, family = _workload_knobs(workload)
     backend, batch, max_k, sieve, factored, hot = auto_tune(
-        backend, batch, max_k, sieve, factored, hot
+        backend, batch, max_k, sieve, factored, hot, family=family
     )
     rolled = not is_tpu()
-    sep, host_min, _native_ok = _workload_knobs(workload)
 
     best: List[Tuple[int, int]] = []  # [(hash, nonce)] — current minimum
     hotloop = _HotLoop(backend, sieve) if hot else None
@@ -1676,6 +1758,7 @@ def sweep_min_hash(
     lanes = run_sweep_dispatches(
         data, lower, upper, max_k, batch, get_kernel, run_kernel, consume,
         host_lane_budget=host_lane_budget, sep=sep, host_min=host_min,
+        family=family,
     )
     if hotloop is not None:
         # The job's ONE carry fetch: every device dispatch folded on

@@ -152,6 +152,14 @@ def _make_sharded_kernel(
             factored=factored,
         )
 
+    return _shard_and_jit(local, mesh, axis_name, sieve)
+
+
+def _shard_and_jit(local, mesh: Mesh, axis_name: str, sieve: bool):
+    """shard_map + collective cascade + jit around one local kernel body
+    — shared by the sha256 and blake2b sharded factories (the cascade is
+    shape-agnostic over the local ``(h0, h1, flat)`` scalars)."""
+
     def shard_fn(midstate, tail_const, bounds, *th):
         h0, h1, flat = local(midstate, tail_const, bounds, *th)
         return _collective_min(h0, h1, flat, axis_name)
@@ -168,6 +176,37 @@ def _make_sharded_kernel(
         check_vma=False,
     )
     return jax.jit(mapped)
+
+
+@lru_cache(maxsize=256)
+def _make_sharded_blake2b_kernel(
+    msg_len: int,
+    tail_off: int,
+    n_tail_blocks: int,
+    live_words: Tuple[int, ...],
+    low_pos: Tuple[DigitPos, ...],
+    k: int,
+    per_dev_batch: int,
+    mesh: Mesh,
+    axis_name: str,
+    sieve: bool = False,
+    factored: int = 0,
+):
+    """The blake2b family's sharded kernel (ISSUE 20): each shard runs
+    the grouped-unrolled u32-pair kernel (ops/blake2b.py) locally —
+    zero-word elision, per-group cache-resident tiles and all — ahead of
+    the same collective argmin cascade, so mesh miners serve the family
+    with the single-device tier's full kernel win.  xla only (the family
+    has no pallas lowering); the shape-class key carries the layout's
+    static fields the sha256 key doesn't need (msg_len / tail_off /
+    live-word set are compiled into the DAG)."""
+    from ..ops.blake2b import make_blake2b_kernel_body
+
+    local = make_blake2b_kernel_body(
+        msg_len, tail_off, n_tail_blocks, live_words, low_pos, k,
+        per_dev_batch, sieve=sieve, factored=factored,
+    )
+    return _shard_and_jit(local, mesh, axis_name, sieve)
 
 
 @lru_cache(maxsize=8)
@@ -277,6 +316,27 @@ def sharded_kernel_for(
     cost model can only be arbitrated on real TPU (the same follow-on as
     the single-device pallas factored rung)."""
     low_pos = layout.digit_pos[layout.digit_count - group.k :]
+    if getattr(layout, "family", "sha256") == "blake2b":
+        if backend != "xla":
+            raise ValueError(
+                f"blake2b kernel family has no {backend!r} tier (xla only)"
+            )
+        return _make_sharded_blake2b_kernel(
+            layout.msg_len,
+            layout.tail_off,
+            layout.n_tail_blocks,
+            layout.live_words,
+            low_pos,
+            group.k,
+            batch_per_device,
+            mesh,
+            axis_name,
+            sieve=sieve,
+            factored=(
+                default_factor_k_in(group.k) if factored and group.k >= 2
+                else 0
+            ),
+        )
     if backend == "pallas":
         from ..ops.pallas_sha256 import dyn_params
 
@@ -407,8 +467,10 @@ def sweep_min_hash_sharded(
     mesh_on_tpu = is_tpu_device(mesh.devices.flat[0])
     if backend is None and not mesh_on_tpu:
         backend = "xla"
+    sep, host_min, _native_ok, family = _workload_knobs(workload)
     backend, batch_per_device, max_k, sieve, factored, hot = auto_tune(
-        backend, batch_per_device, max_k, sieve, factored, hot
+        backend, batch_per_device, max_k, sieve, factored, hot,
+        family=family,
     )
     rolled = not mesh_on_tpu
     batch = n_dev * batch_per_device
@@ -476,10 +538,9 @@ def sweep_min_hash_sharded(
         if not best or cand < best[0]:
             best[:] = [cand]
 
-    sep, host_min, _native_ok = _workload_knobs(workload)
     lanes = run_sweep_dispatches(
         data, lower, upper, max_k, batch, get_kernel, run_kernel, consume,
-        sep=sep, host_min=host_min,
+        sep=sep, host_min=host_min, family=family,
     )
     if hotloop is not None:
         cand = hotloop.finish()

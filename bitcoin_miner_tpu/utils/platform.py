@@ -11,11 +11,17 @@ the device object itself: plugin platform name *and* ``device_kind``
 from __future__ import annotations
 
 from functools import lru_cache
+from typing import Optional
 
 import jax
 
 # Known PJRT platform names that front real TPU hardware.
 _TPU_PLATFORMS = frozenset({"tpu", "axon"})
+
+# Known PJRT platform names that front real GPU hardware (jax registers
+# CUDA devices as "gpu" or "cuda" depending on plugin vintage; ROCm as
+# "rocm").
+_GPU_PLATFORMS = frozenset({"gpu", "cuda", "rocm"})
 
 
 def is_tpu_device(dev) -> bool:
@@ -27,11 +33,43 @@ def is_tpu_device(dev) -> bool:
     return "tpu" in kind
 
 
+def is_gpu_device(dev) -> bool:
+    """True if ``dev`` (a jax Device) is a GPU, whatever its plugin's
+    registered platform name (same probe shape as :func:`is_tpu_device`:
+    platform name first, ``device_kind`` as the fallback)."""
+    if (dev.platform or "").lower() in _GPU_PLATFORMS:
+        return True
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    return any(t in kind for t in ("nvidia", "radeon", "amd instinct"))
+
+
 @lru_cache(maxsize=1)
 def is_tpu() -> bool:
     """True if the default JAX backend fronts TPU hardware (initializes the
     backend on first call; cached per process)."""
     return is_tpu_device(jax.devices()[0])
+
+
+@lru_cache(maxsize=1)
+def pallas_platform() -> Optional[str]:
+    """Which Pallas lowering the default backend's devices would take:
+    ``"mosaic"`` on TPU, ``"triton"`` on GPU, ``None`` on CPU (no
+    lowering — the interpreter is a test rig, not a tier).
+
+    This is the probe the sweep drivers' rung resolution and the bench
+    stamps consult (ISSUE 20): rung *defaults* stay conservative — the
+    pallas tier is ON by default only under the Mosaic lowering, where
+    its wins are measured; a Triton host resolves to the xla tier until
+    a GPU bench prices the rung (ROADMAP follow-on) — but the probe
+    result rides every bench JSON line so off-host analysis can tell a
+    "pallas off: no lowering" host from a "pallas off: unpriced Triton"
+    one."""
+    dev = jax.devices()[0]
+    if is_tpu_device(dev):
+        return "mosaic"
+    if is_gpu_device(dev):
+        return "triton"
+    return None
 
 
 def device_desc(dev) -> str:

@@ -24,9 +24,10 @@ Registered workloads:
   so it inherits the ENTIRE device stack (pallas/xla kernels, midstate
   folding) through the layout builder's separator parameter.
 - ``blake2b64`` — BLAKE2b-64 over ``"<data> <nonce>"`` (the
-  exchange-benchmark paper's fastest software family): no device tier,
-  host ladder only — the proof a registered workload without kernels
-  still rides the whole serving stack.
+  exchange-benchmark paper's fastest software family): since ISSUE 20
+  a SECOND device kernel family (``ops/blake2b.py`` — u32-pair
+  explicit-carry kernel, midstate-folded prefix) behind the same
+  sweep pipeline; tier ladder ``xla -> cpu -> hashlib``.
 
 One workload per process: the wire protocol stays the frozen
 ``(data, lower, upper)`` triple, so a server, its miners, and its
@@ -228,7 +229,7 @@ register(
         "blake2b64",
         description=(
             "BLAKE2b-64('<data> <nonce>') big-endian (exchange-benchmark "
-            "alternative hash family; host tiers only)"
+            "alternative hash family; xla device tier + host ladder)"
         ),
         golden=(
             ("hello", 0, 6710974778312606399),

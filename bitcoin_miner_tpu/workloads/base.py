@@ -62,9 +62,15 @@ class Workload:
     #: contract pass recomputes these for every registered workload).
     golden: Tuple[GoldenVector, ...] = ()
     #: ASCII byte(s) between ``data`` and the decimal nonce, for
-    #: workloads the SHA-256 template kernels can serve (ops/sweep reads
-    #: this to build message layouts); None = no device tier.
+    #: workloads the device message-template kernels can serve
+    #: (ops/sweep reads this to build message layouts); None = no
+    #: device tier.
     sep: Optional[bytes] = None
+    #: Which device kernel family serves this workload's message format
+    #: ("sha256" or "blake2b" — ISSUE 20): picks the layout builder and
+    #: jitted kernel the sweep drivers compile.  Meaningful only with
+    #: :attr:`sep` set.
+    kernel_family: str = "sha256"
     #: Whether the compiled C++ SHA-NI sweep (native/) computes this
     #: workload — true only for the frozen default's message format.
     native_ok: bool = False
@@ -102,9 +108,10 @@ class Workload:
 
     def make_search(self, tier: str, devices: Optional[int] = None):
         """A synchronous ``(data, lower, upper) -> (hash, nonce)`` search
-        on ``tier``.  Device tiers exist only for workloads the SHA-256
-        template kernels serve (:attr:`sep` set); ``devices`` spans the
-        jax tiers over an N-chip mesh."""
+        on ``tier``.  Device tiers exist only for workloads a device
+        kernel family serves (:attr:`sep` set — the family is
+        :attr:`kernel_family`); ``devices`` spans the jax tiers over an
+        N-chip mesh."""
         self._check_tier(tier)
         if tier in ("hashlib", "cpu") and devices is not None and devices != 1:
             raise ValueError(
@@ -119,6 +126,11 @@ class Workload:
             raise ValueError(
                 f"workload {self.name!r} declares device tier {tier!r} "
                 "but no message template (sep)"
+            )
+        if tier == "pallas" and self.kernel_family != "sha256":
+            raise ValueError(
+                f"workload {self.name!r}: the {self.kernel_family!r} "
+                "kernel family has no pallas lowering"
             )
         if devices is not None and devices != 1:
             if devices < 1:

@@ -2,13 +2,16 @@
 
 The exchange-benchmark paper (PAPERS.md, arxiv 2408.11950) evaluates
 hash families beyond SHA-256 for blockchain serving; BLAKE2b is its
-fastest software family and ships in hashlib, so it is the registry's
-proof that a workload with NO SHA-256 message template — and therefore
-no device tier — still rides the entire serving stack: scheduler
-validation, gateway cache/spans, federation routing, chaos drills.  Its
-tier ladder is ``cpu -> hashlib`` (the cpu tier is a prefix-folded batch
-loop, the hashlib tier the naive oracle); the watchdog chain degrades
-across exactly those rungs.
+fastest software family and ships in hashlib.  Since ISSUE 20 this
+workload is the registry's proof that a SECOND kernel family rides the
+whole device plane: its tier ladder is ``xla -> cpu -> hashlib``, where
+the xla rung is the grouped-unrolled u32-pair BLAKE2b kernel
+(ops/blake2b.py — explicit-carry 64-bit adds, midstate-folded constant
+prefix, zero-word-elided unrolled compression) behind the exact same
+``SweepPipeline`` / hot-plane / sharded-mesh machinery as the SHA-256
+default, the cpu tier a prefix-folded hashlib batch loop, and the
+hashlib tier the naive oracle.  The watchdog chain degrades across
+exactly those rungs.
 
 ``f(data, nonce) = BLAKE2b(digest_size=8)("<data> <nonce>")`` read
 big-endian — digest size is a parameter of the BLAKE2 spec (it keys the
@@ -26,8 +29,9 @@ from .base import GoldenVector, Workload
 class Blake2bWorkload(Workload):
     """BLAKE2b-64 over ``"<data> <nonce>"`` (see module docstring)."""
 
-    tiers = ("cpu", "hashlib")
-    sep = None  # no SHA-256 message template: host tiers only
+    tiers = ("xla", "cpu", "hashlib")
+    sep = b" "
+    kernel_family = "blake2b"
     native_ok = False
 
     def __init__(

@@ -479,6 +479,49 @@ def test_trace_pass_collects_hot_step_bodies():
     assert "_merge" in names
 
 
+def test_trace_pass_collects_blake2b_kernel_bodies():
+    """ISSUE 20 coverage meta-test: the trace-safety lint must SEE the
+    second kernel family's bodies — the blake2b compression sweep body
+    nested in ``make_blake2b_kernel_body`` (and the sharded wrapper's, in
+    parallel/sweep.py) via the grown ``|blake2b`` factory convention, and
+    the module-level u32-pair device primitives via their explicit
+    ``# jit-kernel`` marks.  If a refactor renames a factory outside the
+    convention or drops a mark, this test (not silence) fails."""
+    import ast
+
+    from tools.analyze.common import file_comments
+    from tools.analyze.tracecheck import FACTORY_RE, _collect_kernel_bodies
+
+    # The blake2b factory naming is part of the convention now.
+    assert FACTORY_RE.search("make_blake2b_kernel_body")
+    assert FACTORY_RE.search("_make_blake2b_kernel")
+    assert FACTORY_RE.search("_make_sharded_blake2b_kernel")
+    collected = {}
+    for mod in ("ops/blake2b.py", "parallel/sweep.py"):
+        src = (REPO / "bitcoin_miner_tpu" / mod).read_text()
+        names = [
+            fn.name
+            for fn in _collect_kernel_bodies(ast.parse(src), file_comments(src))
+        ]
+        collected[mod] = names
+    # The factory-nested compression sweep body...
+    assert "kernel" in collected["ops/blake2b.py"]
+    # ...the marked module-level device primitives the body calls into
+    # (they sit outside any factory, so only the marks admit them)...
+    for helper in ("_addm", "_rotr64", "_G", "_compress_pairs", "_bswap32"):
+        assert helper in collected["ops/blake2b.py"]
+    # ...and the mesh plane's traced bodies the sharded blake2b factory
+    # composes: the per-shard `local` body and the collective-cascade
+    # `shard_fn` wrapper (the blake2b body itself is built in
+    # ops/blake2b.py and collected there as `kernel`).
+    assert {"local", "shard_fn"} <= set(collected["parallel/sweep.py"])
+    # The contracts pass pins the same family's arithmetic end-to-end:
+    # every blake2b64 golden recomputes through the xla device tier.
+    from tools.analyze.contracts import WORKLOAD_DEVICE_TIERS
+
+    assert WORKLOAD_DEVICE_TIERS.get("blake2b64") == "xla"
+
+
 # --------------------------------------------------------------------------
 # 2b. lockcheck --fix: the mechanical lock fixer (ISSUE 12 carry-over)
 # --------------------------------------------------------------------------

@@ -141,6 +141,35 @@ def test_hot_compare_fast_leg():
     assert out["kept_kernel"] in ("per-chunk", "hot")
 
 
+def test_tier_compare_fast_leg():
+    """``--tier-compare --fast`` (ISSUE 20): the tier-1 correctness leg
+    of the heterogeneous-plane comparison — the blake2b64 device tier and
+    the cpu tier both oracle-gated on digit-boundary ranges (long AND
+    sub-block-tail payload shapes) before the tiny timed windows, with
+    the JSON honest about the platform, the pallas rung probe, and what
+    auto_tune keeps for the family (BENCH_pr20.json is the full-speed
+    same-seed artifact; the --fast ratio is load-noisy, so no ratio
+    assertion here)."""
+    p = run_bench("--tier-compare", "--workload", "blake2b64", "--fast", "--cpu")
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = p.stdout.strip().splitlines()
+    assert len(lines) == 1, lines
+    out = json.loads(lines[0])
+    assert out["metric"] == "tier_compare"
+    assert out["workload"] == "blake2b64"
+    assert out["device_tier"] == "xla"
+    assert out["bitexact"] is True
+    assert out["device_nps"] > 0 and out["cpu_nps"] > 0
+    assert out["short_device_nps"] > 0 and out["short_cpu_nps"] > 0
+    assert out["fast"] is True
+    # Honesty fields: the pallas rung must be reported as probed (null
+    # off-TPU/GPU — never silently assumed), and kept_kernel must record
+    # exactly what auto_tune picks for the blake2b family on this host.
+    assert "pallas_platform" in out
+    assert out["auto_tune_factored"] == ("factored" in out["kept_kernel"])
+    assert out["auto_tune_hot"] == ("hot" in out["kept_kernel"])
+
+
 def test_cpu_bench_emits_one_valid_json_line():
     p = run_bench("--cpu")
     assert p.returncode == 0, p.stderr[-2000:]

@@ -1053,3 +1053,146 @@ class TestPipelineLifecycle:
         assert len(results) == 6
         for got, want in results.values():
             assert got == want
+
+
+@pytest.mark.workloads
+class TestBlake2bDeviceTier:
+    """The second kernel family (ISSUE 20): the u32-pair BLAKE2b-64
+    device kernel vs the workload's hashlib oracle.  The adversarial
+    matrix mirrors TestSieve/TestFactored's: digit-class boundaries
+    (9→10, 99→100, 999→1000), the u64 upper edge, duplicate minima with
+    the lowest-nonce tie-break through a direct kernel call, a
+    multi-dispatch leg cross-checked per-nonce against the pure-Python
+    compression (the layout machinery itself in the loop), and the
+    watchdog downgrade drill across the family's xla→cpu→hashlib chain."""
+
+    @staticmethod
+    def _wl():
+        from bitcoin_miner_tpu import workloads
+
+        return workloads.get("blake2b64")
+
+    @pytest.mark.parametrize(
+        "lo,hi",
+        [
+            (5, 15),       # 9→10: d=1 and d=2 classes in one sweep
+            (93, 107),     # 99→100 digit-class boundary
+            (985, 1040),   # 999→1000
+        ],
+    )
+    def test_digit_class_boundaries(self, lo, hi):
+        w = self._wl()
+        r = sweep_min_hash("cmu440", lo, hi, backend="xla", max_k=2, workload=w)
+        assert (r.hash, r.nonce) == w.min_range("cmu440", lo, hi)
+        assert r.lanes_swept == hi - lo + 1
+
+    def test_u64_upper_edge(self):
+        w = self._wl()
+        top = (1 << 64) - 1
+        r = sweep_min_hash(
+            "big", top - 50, top, backend="xla", max_k=1, workload=w
+        )
+        assert (r.hash, r.nonce) == w.min_range("big", top - 50, top)
+
+    def test_sieve_threshold_operand_bit_exact(self):
+        # The kernel's carried-threshold mask (built for the hot plane's
+        # operand) must stay bit-exact when forced on.
+        w = self._wl()
+        r = sweep_min_hash(
+            "cmu440", 93, 320, backend="xla", max_k=2, sieve=True, workload=w
+        )
+        assert (r.hash, r.nonce) == w.min_range("cmu440", 93, 320)
+
+    @pytest.mark.parametrize("dlen", [126, 250])
+    def test_tail_shape_classes_bit_exact(self, dlen):
+        """The family's two adversarial tail shapes beyond the short-data
+        tests above: a message straddling the 128-byte block boundary
+        (digit bytes land past byte 128 → two tail blocks), and a prefix
+        long enough that whole blocks fold into the midstate host-side.
+        Each data LENGTH is its own compiled shape class, so two lengths
+        buy the coverage without a compile per fuzz draw."""
+        w = self._wl()
+        data = "f" * dlen
+        r = sweep_min_hash(data, 93, 107, backend="xla", max_k=2, workload=w)
+        assert (r.hash, r.nonce) == w.min_range(data, 93, 107)
+
+    def test_multi_dispatch_cross_checked_per_nonce(self):
+        # batch=2 at k=2 → many dispatches across two digit classes; the
+        # fold must agree per-nonce with the pure-Python compression
+        # (digest64_py), putting the blake2b layout machinery itself in
+        # the loop rather than trusting hashlib's message assembly.
+        from bitcoin_miner_tpu.ops.blake2b import digest64_py
+
+        w = self._wl()
+        lo, hi = 100, 1299
+        r = sweep_min_hash(
+            "cmu440", lo, hi, backend="xla", max_k=2, batch=2, workload=w
+        )
+        best = None
+        for n in range(lo, hi + 1):
+            cand = (digest64_py(b"cmu440 " + str(n).encode()), n)
+            if best is None or cand < best:
+                best = cand
+        assert (r.hash, r.nonce) == best
+
+    def test_duplicate_minimum_lowest_nonce(self):
+        """Duplicate chunk rows covering the same range tie on (h0, h1)
+        everywhere; the kernel's flat argmin (and the factored remap
+        behind it) must resolve to row 0 → the lowest nonce."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bitcoin_miner_tpu.ops.blake2b import (
+            build_layout as b2_layout,
+            make_blake2b_kernel_body,
+        )
+
+        w = self._wl()
+        layout = b2_layout(b"tie", 3)
+        h, n = w.min_range("tie", 100, 199)
+        kern = make_blake2b_kernel_body(
+            layout.msg_len, layout.tail_off, layout.n_tail_blocks,
+            layout.live_words, layout.digit_pos[1:], 2, batch=2,
+        )
+        row = np.array(layout.tail_template, dtype=np.uint32)
+        dp = layout.digit_pos[0]
+        row[dp.word] |= np.uint32(ord("1") << dp.shift)  # high digit '1'
+        tail_const = np.tile(row, (2, 1))
+        bounds = np.array([[0, 100], [0, 100]], dtype=np.int32)
+        midstate = np.array(layout.midstate, dtype=np.uint32)
+        h0, h1, idx = kern(
+            jnp.asarray(midstate), jnp.asarray(tail_const), jnp.asarray(bounds)
+        )
+        assert (int(h0), int(h1)) == (h >> 32, h & 0xFFFFFFFF)
+        # Both rows are nonces [100, 199]; the winner must be row 0.
+        assert int(idx) == n - 100
+
+    def test_wedge_dispatch_downgrades_xla_to_cpu(self, monkeypatch):
+        """The watchdog drill across the family's NEW 3-rung chain:
+        ``BMT_WEDGE_DISPATCH=1`` hangs the blake2b xla pipeline's first
+        fetch; the watchdog abandons the device rung and the chunk
+        re-runs bit-exact on the cpu rung — hashlib still behind it."""
+        from bitcoin_miner_tpu.apps import miner as miner_mod
+        from bitcoin_miner_tpu.ops import sweep as sweep_mod
+        from bitcoin_miner_tpu.utils.metrics import METRICS
+
+        w = self._wl()
+        monkeypatch.setenv("BMT_WEDGE_DISPATCH", "1")
+        monkeypatch.setitem(sweep_mod._WEDGE_STATE, "fired", False)
+        downgrades0 = METRICS.get("miner.tier_downgrades")
+        ts = miner_mod._TieredSearch(
+            [
+                ("xla", lambda: w.make_async_search("xla")),
+                ("cpu", lambda: w.make_async_search("cpu")),
+                ("hashlib", lambda: w.min_range),
+            ],
+            wedge_seconds=4.0,
+        )
+        try:
+            fut = ts.submit("b2wedge", 0, 120)
+            assert fut.result(timeout=120) == w.min_range("b2wedge", 0, 120)
+            assert ts.active_tier == "cpu"
+            assert METRICS.get("miner.tier_downgrades") - downgrades0 == 1
+            assert sweep_mod._WEDGE_STATE["fired"]  # the hang was real
+        finally:
+            ts.close()

@@ -147,14 +147,26 @@ class TestTierLadders:
             "pallas", "xla", "cpu", "hashlib")
         assert workloads.get("preimage").tiers == (
             "pallas", "xla", "cpu", "hashlib")
-        assert workloads.get("blake2b64").tiers == ("cpu", "hashlib")
+        # ISSUE 20: blake2b64 grew a real device tier (ops/blake2b.py);
+        # no pallas rung — the family has no Mosaic lowering yet.
+        assert workloads.get("blake2b64").tiers == ("xla", "cpu", "hashlib")
 
-    def test_host_only_workload_refuses_device_tiers(self):
+    def test_blake2b_refuses_pallas_tier(self):
         b = workloads.get("blake2b64")
-        with pytest.raises(ValueError, match="no 'xla' tier"):
-            b.make_search("xla")
+        with pytest.raises(ValueError, match="no 'pallas' tier"):
+            b.make_search("pallas")
         with pytest.raises(ValueError, match="no 'pallas' tier"):
             miner_mod.make_search("pallas", workload=b)
+
+    def test_blake2b_xla_tier_bit_exact(self):
+        """ISSUE 20's device half: the u32-pair blake2b kernel runs the
+        workload bit-exact vs its own hashlib oracle across a digit-class
+        boundary, and genuinely hashes the blake2b message (differs from
+        the sha256 families on the same range)."""
+        w = workloads.get("blake2b64")
+        search = w.make_search("xla")
+        assert search("b2dev", 95, 320) == w.min_range("b2dev", 95, 320)
+        assert search("b2dev", 95, 320) != min_hash_range("b2dev", 95, 320)
 
     def test_preimage_xla_tier_bit_exact(self):
         """The tentpole's device half: the separator-parameterized layout
@@ -180,6 +192,8 @@ class TestTierLadders:
                 s.close()
 
     def test_tiered_chain_is_the_workloads_ladder(self):
+        # auto on a CPU host resolves to the cpu rung; the chain is the
+        # suffix of the workload's own ladder from there.
         ts = miner_mod.make_tiered_search(
             "auto", workload=workloads.get("blake2b64")
         )
@@ -194,9 +208,18 @@ class TestTierLadders:
             assert [t for t, _ in ts._chain] == ["xla", "cpu", "hashlib"]
         finally:
             ts.close()
-        with pytest.raises(ValueError, match="no 'xla' tier"):
+        # ISSUE 20: the blake2b64 device rung heads the 3-rung watchdog
+        # chain when asked for explicitly.
+        ts = miner_mod.make_tiered_search(
+            "xla", workload=workloads.get("blake2b64")
+        )
+        try:
+            assert [t for t, _ in ts._chain] == ["xla", "cpu", "hashlib"]
+        finally:
+            ts.close()
+        with pytest.raises(ValueError, match="no 'pallas' tier"):
             miner_mod.make_tiered_search(
-                "xla", workload=workloads.get("blake2b64")
+                "pallas", workload=workloads.get("blake2b64")
             )
 
 

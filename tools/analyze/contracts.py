@@ -19,7 +19,11 @@ recomputes and compares, no network, no device:
   pin the reference contract gets, so no workload's hash family can
   drift silently; the DEFAULT workload must additionally agree with the
   reference ``bitcoin/hash`` vectors byte-for-byte (the sha256d path is
-  the frozen contract, registry or not).
+  the frozen contract, registry or not).  Workloads that ship their own
+  device kernel family (ISSUE 20: blake2b64 / ``ops/blake2b.py``) get a
+  second recompute through that tier itself — single-nonce device
+  sweeps — because a from-scratch kernel can drift while the hashlib
+  oracle stays green.
 - **CLI stdout**: the usage strings (driven through ``main()`` with a
   wrong argc) and the literal ``Result``/``Disconnected``/``Server
   listening`` prints, pinned at source level.
@@ -256,6 +260,7 @@ def _check_workloads(findings: List[Finding]) -> None:
                         f"drifted: {got} != frozen {frozen}",
                     )
                 )
+    _check_device_tiers(findings)
     # The default's oracle must equal the reference contract itself.
     try:
         w = workloads.get(WORKLOAD_DEFAULT_NAME)
@@ -277,6 +282,76 @@ def _check_workloads(findings: List[Finding]) -> None:
                     "bitcoin/hash contract vectors",
                 )
             )
+
+
+#: Workload name -> device tier whose KERNEL (not just the hash_nonce
+#: oracle) must reproduce the golden vectors (ISSUE 20).  The oracle
+#: recompute above pins each family's host reference; for families that
+#: also ship a device kernel (ops/blake2b.py — a from-scratch u32-pair
+#: reimplementation of the compression function, not a hashlib call),
+#: the kernel's arithmetic is a SECOND independent surface that can
+#: drift while the oracle stays green, and the sweep drivers would then
+#: serve wrong minima whenever that tier wins the ladder.  Single-nonce
+#: sweeps ([n, n], host_lane_budget=0 so nothing routes to a host fold)
+#: force every golden through the full device path: layout build,
+#: midstate fold, device compression, min-fold epilogue.
+WORKLOAD_DEVICE_TIERS = {"blake2b64": "xla"}
+
+
+def _check_device_tiers(findings: List[Finding]) -> None:
+    from bitcoin_miner_tpu import workloads
+    from bitcoin_miner_tpu.ops.sweep import sweep_min_hash
+    from bitcoin_miner_tpu.utils.platform import enable_compile_cache
+
+    # The golden vectors span ~5 kernel shape classes; the persistent
+    # XLA cache makes every run after the first pay import cost only
+    # (matters: this pass runs in pre-commit --changed and three tier-1
+    # subprocesses).
+    enable_compile_cache()
+
+    for name, tier in WORKLOAD_DEVICE_TIERS.items():
+        try:
+            w = workloads.get(name)
+        except ValueError:
+            findings.append(
+                Finding(
+                    PASS, "workload-device-tier", _WORKLOADS_PATH, 1, name,
+                    f"device-tier-pinned workload {name!r} not registered",
+                )
+            )
+            continue
+        if tier not in w.tiers:
+            findings.append(
+                Finding(
+                    PASS, "workload-device-tier", _WORKLOADS_PATH, 1, name,
+                    f"workload no longer ladders the pinned device tier "
+                    f"{tier!r} (tiers: {w.tiers})",
+                )
+            )
+            continue
+        for data, nonce, frozen in w.golden:
+            try:
+                r = sweep_min_hash(
+                    data, nonce, nonce, backend=tier, workload=w
+                )
+            except Exception as e:  # a crash IS a contract break
+                findings.append(
+                    Finding(
+                        PASS, "workload-device-vector", _WORKLOADS_PATH, 1,
+                        f"{name}:{tier}({data!r},{nonce})",
+                        f"device sweep raised {e!r}",
+                    )
+                )
+                continue
+            if r.hash != frozen or r.nonce != nonce:
+                findings.append(
+                    Finding(
+                        PASS, "workload-device-vector", _WORKLOADS_PATH, 1,
+                        f"{name}:{tier}({data!r},{nonce})",
+                        f"device tier drifted from the frozen vector: "
+                        f"({r.hash}, {r.nonce}) != ({frozen}, {nonce})",
+                    )
+                )
 
 
 def run(

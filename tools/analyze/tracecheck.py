@@ -66,9 +66,16 @@ PASS = "trace"
 
 #: Kernel-factory naming convention the lint keys on; ``hot`` (ISSUE 16)
 #: admits the always-hot plane's donated-step factories (make_hot_step),
-#: whose ring-loop step bodies trace like any kernel body.
+#: whose ring-loop step bodies trace like any kernel body; ``blake2b``
+#: (ISSUE 20) admits the second kernel family's factories
+#: (``make_blake2b_kernel_body`` / ``_make_blake2b_kernel`` /
+#: ``build_kernel_for`` in ops/blake2b.py and the sharded wrapper in
+#: parallel/sweep.py) so the u32-pair compression bodies are gated like
+#: the sha256 plane's — its module-level device primitives (``_G``,
+#: ``_compress_pairs``, ...) carry explicit ``# jit-kernel`` marks since
+#: they sit outside any factory.
 FACTORY_RE = re.compile(
-    r"(make|build).*(kernel|minhash|sieve|factored|hot|call)"
+    r"(make|build).*(kernel|minhash|sieve|factored|hot|call|blake2b)"
 )
 
 #: Default scan scope in repo mode: the accelerator layers.
